@@ -1,0 +1,25 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new design (not a port) of the reference ``MXNetEdge/incubator-mxnet``
+per ``SURVEY.md``: imperative NDArray + per-op autograd, Gluon blocks with
+``hybridize()`` -> XLA jit, KVStore over ICI/DCN collectives, RecordIO data
+pipeline, AMP, Pallas fused kernels.  Compute substrate: JAX/XLA/PJRT.
+
+Typical use mirrors the reference::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import (Context, cpu, cpu_pinned, current_context, gpu,
+                      num_gpus, num_tpus, tpu)
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import autograd

@@ -1,0 +1,74 @@
+"""Base utilities: errors, registries, naming.
+
+TPU-native re-design of the reference's ``python/mxnet/base.py`` and
+dmlc-core error machinery (reference: ``python/mxnet/base.py :: check_call,
+MXNetError``; ``3rdparty/dmlc-core/include/dmlc/logging.h``).  There is no C
+ABI boundary here: the compute substrate is JAX/XLA, so errors are native
+Python exceptions raised at op-call or sync points.
+"""
+from __future__ import annotations
+
+import re
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: ``base.py :: MXNetError``).
+
+    Raised for shape/type inference failures, bad op arguments, and errors
+    surfaced at synchronization points (``asnumpy``, ``wait_to_read``) --
+    mirroring the reference's async error propagation contract
+    (``src/engine/threaded_engine.cc :: OnCompleteStatic``).
+    """
+
+
+def check_call(ret):
+    """Compatibility no-op: there is no flat C ABI in the TPU build."""
+    return ret
+
+
+_CAMEL_RE1 = re.compile(r"(.)([A-Z][a-z]+)")
+_CAMEL_RE2 = re.compile(r"([a-z0-9])([A-Z])")
+
+
+def camel_to_snake(name: str) -> str:
+    s = _CAMEL_RE1.sub(r"\1_\2", name)
+    return _CAMEL_RE2.sub(r"\1_\2", s).lower()
+
+
+class _NameManager:
+    """Auto-naming scope (reference: ``python/mxnet/name.py :: NameManager``)."""
+
+    _current = None
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    @classmethod
+    def current(cls):
+        if cls._current is None:
+            cls._current = _NameManager()
+        return cls._current
+
+
+def build_param_doc(params) -> str:
+    """Render an op's typed parameter list as a numpydoc section.
+
+    TPU-native analog of the reference's dmlc::Parameter ``__DOC__``
+    generation (``3rdparty/dmlc-core/include/dmlc/parameter.h``): the op
+    registry is self-describing and Python signatures/docstrings are
+    generated from it at import time.
+    """
+    lines = ["Parameters", "----------"]
+    for p in params:
+        lines.append("%s : %s, optional, default=%r" % (p.name, p.type_str, p.default)
+                     if p.has_default else "%s : %s, required" % (p.name, p.type_str))
+        if p.doc:
+            lines.append("    " + p.doc)
+    return "\n".join(lines)

@@ -1,0 +1,32 @@
+"""Engine shim.
+
+The reference's dependency engine (``src/engine/threaded_engine.cc``)
+schedules every mutation as an async op over versioned vars.  On TPU,
+XLA/PJRT's async runtime already provides dataflow ordering and async
+dispatch (SURVEY.md §1), so this module keeps only the *control surface*:
+sync points, a bulk scope (no-op: XLA fuses), and the naive-engine debug
+switch (eager blocking mode for race isolation).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .ndarray.ndarray import waitall  # re-export  # noqa: F401
+
+_blocking = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def set_bulk_size(size):
+    """Reference: ``mx.engine.set_bulk_size`` -- XLA fusion makes bulking
+    automatic; retained for API parity."""
+    return size
+
+
+@contextlib.contextmanager
+def bulk(size):
+    yield
+
+
+def is_blocking():
+    return _blocking

@@ -1,0 +1,13 @@
+"""``mx.nd``: the imperative NDArray API (reference: ``python/mxnet/ndarray/``)."""
+import sys as _sys
+
+from .ndarray import (NDArray, array, arange, concat, concatenate, empty,
+                      from_jax, full, invoke, load, moveaxis, ones,
+                      onehot_encode, save, waitall, zeros)
+from . import register as _register
+from . import random  # noqa: F401
+
+_register.populate(_sys.modules[__name__].__dict__)
+
+# `mx.nd.op` namespace mirror (reference exposes ops both flat and nested)
+op = _sys.modules[__name__]

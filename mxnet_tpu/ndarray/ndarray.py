@@ -1,0 +1,725 @@
+"""NDArray: the imperative tensor.
+
+TPU-native re-design of the reference's ``src/ndarray/ndarray.cc ::
+NDArray`` and ``python/mxnet/ndarray/ndarray.py``.  An NDArray wraps a
+``jax.Array``.  JAX/PJRT's async dispatch replaces the reference's
+dependency engine (SURVEY.md L1): op calls return immediately with a
+future-backed array; ``asnumpy()`` / ``wait_to_read()`` are the sync
+points, where device-side errors surface (the reference's
+``MXNDArraySyncCopyToCPU`` contract).
+
+Mutation semantics (`a += b`, ``a[...] = v``, optimizer updates) are
+version-rebinding: the Python object stays, its ``_data`` handle moves to a
+new functional array (donation lets XLA reuse the buffer).  Basic-slice
+*views* therefore copy rather than alias -- the one intentional divergence
+from the reference, documented here.
+"""
+from __future__ import annotations
+
+import functools
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random_mod
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ops.registry import Op, get_op
+
+__all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
+           "concat", "concatenate", "save", "load", "invoke", "waitall",
+           "moveaxis", "from_jax", "onehot_encode"]
+
+_MX_DTYPE_TO_FLAG = {
+    np.dtype("float32"): 0, np.dtype("float64"): 1, np.dtype("float16"): 2,
+    np.dtype("uint8"): 3, np.dtype("int32"): 4, np.dtype("int8"): 5,
+    np.dtype("int64"): 6,
+}
+_FLAG_TO_MX_DTYPE = {v: k for k, v in _MX_DTYPE_TO_FLAG.items()}
+# bfloat16 is TPU-native; give it a flag outside the reference's range.
+_MX_DTYPE_TO_FLAG[np.dtype(jnp.bfloat16.dtype)] = 100
+_FLAG_TO_MX_DTYPE[100] = np.dtype(jnp.bfloat16.dtype)
+
+
+def waitall():
+    """Block until all async work completes (reference:
+    ``mx.nd.waitall`` / ``Engine::WaitForAll``)."""
+    try:
+        (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
+    except Exception:
+        pass
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:
+            pass
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class NDArray:
+    """An n-dimensional array on a device context."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_ag_node", "_ag_out_index",
+                 "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if ctx is not None and not _is_traced(data):
+            data = jax.device_put(jnp.asarray(data), ctx.jax_device())
+        elif not isinstance(data, jax.Array) and not _is_traced(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self._grad = None
+        self._grad_req = "write"
+        self._ag_node = None
+        self._ag_out_index = 0
+
+    # -- basic properties ---------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def context(self):
+        if _is_traced(self._data):
+            return current_context()
+        dev = next(iter(self._data.devices()))
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    ctx = context
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    # -- sync / conversion --------------------------------------------
+    def asnumpy(self):
+        """Blocking copy to host (reference: ``MXNDArraySyncCopyToCPU``)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("asscalar: array is not scalar-sized")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise MXNetError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def wait_to_read(self):
+        if not _is_traced(self._data):
+            self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self.wait_to_read()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True):
+        return NDArray(self._data.astype(np.dtype(dtype)))
+
+    def copy(self):
+        return NDArray(jnp.array(self._data))
+
+    def copyto(self, other):
+        """Copy to another array or context (reference: ``CopyFromTo``)."""
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()))
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, next(iter(other._data.devices()))) \
+                if not _is_traced(other._data) else self._data
+            return other
+        raise MXNetError("copyto: bad target %r" % (other,))
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage not supported in this build")
+        return self
+
+    # -- autograd ------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (reference: ``ndarray.py ::
+        attach_grad``); marks this array as a differentiable leaf,
+        detaching it from any previously recorded graph."""
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        self._ag_node = None
+        self._ag_out_index = 0
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def _is_tracked(self):
+        return self._ag_node is not None or \
+            (self._grad is not None and self._grad_req != "null")
+
+    # -- indexing ------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            kd = key._data
+            if kd.dtype == jnp.bool_:
+                return NDArray(self._data[np.asarray(kd)])
+            return NDArray(jnp.take(self._data, kd.astype(jnp.int32), axis=0))
+        key = tuple(k._data if isinstance(k, NDArray) else k for k in key) \
+            if isinstance(key, tuple) else key
+        return NDArray(self._data[key])
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            key = tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            v = jnp.asarray(value, dtype=self._data.dtype)
+            self._data = jnp.broadcast_to(v, self.shape) + jnp.zeros_like(self._data) \
+                if v.shape != self.shape else v
+        else:
+            self._data = self._data.at[key].set(value)
+
+    # -- arithmetic (rebinding in-place forms) ------------------------
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, NDArray):
+            rhs = other
+        else:
+            rhs = NDArray(jnp.asarray(other, dtype=self._data.dtype))
+        lhs = self
+        if reverse:
+            lhs, rhs = rhs, lhs
+        return invoke(get_op(opname), [lhs, rhs], {})
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elemwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "elemwise_div", reverse=True)
+
+    def __mod__(self, o):
+        return self._binop(o, "broadcast_mod")
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __rpow__(self, o):
+        return self._binop(o, "broadcast_power", reverse=True)
+
+    def __matmul__(self, o):
+        return invoke(get_op("dot"), [self, o], {})
+
+    def __neg__(self):
+        return invoke(get_op("negative"), [self], {})
+
+    def __abs__(self):
+        return invoke(get_op("abs"), [self], {})
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal")
+
+    def __ne__(self, o):
+        return self._binop(o, "broadcast_not_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, "broadcast_greater")
+
+    def __ge__(self, o):
+        return self._binop(o, "broadcast_greater_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, "broadcast_lesser")
+
+    def __le__(self, o):
+        return self._binop(o, "broadcast_lesser_equal")
+
+    __hash__ = object.__hash__
+
+    def _inplace_guard(self):
+        # Mirrors the reference's restriction: in-place writes to an array
+        # that participates in a recorded graph would corrupt the tape.
+        if autograd.is_recording() and self._is_tracked():
+            raise MXNetError(
+                "in-place operation on an array that requires grad inside "
+                "autograd.record() is not allowed; use out-of-place ops")
+
+    def __iadd__(self, o):
+        self._inplace_guard()
+        self._data = self.__add__(o)._data
+        self._ag_node = None
+        return self
+
+    def __isub__(self, o):
+        self._inplace_guard()
+        self._data = self.__sub__(o)._data
+        self._ag_node = None
+        return self
+
+    def __imul__(self, o):
+        self._inplace_guard()
+        self._data = self.__mul__(o)._data
+        self._ag_node = None
+        return self
+
+    def __itruediv__(self, o):
+        self._inplace_guard()
+        self._data = self.__truediv__(o)._data
+        self._ag_node = None
+        return self
+
+    def __repr__(self):
+        if _is_traced(self._data):
+            return "<NDArray traced %s %s>" % (self.shape, self.dtype)
+        return "%s\n<NDArray %s @%s>" % (
+            np.array2string(self.asnumpy(), precision=4, suppress_small=True),
+            "x".join(str(s) for s in self.shape) or "scalar", self.context)
+
+    # -- common method forms of ops (subset of the generated surface) --
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke(get_op("Reshape"), [self], {"shape": shape, **kwargs})
+
+    def reshape_like(self, other):
+        return invoke(get_op("reshape_like"), [self, other], {})
+
+    def flatten(self):
+        return invoke(get_op("Flatten"), [self], {})
+
+    def transpose(self, axes=None):
+        return invoke(get_op("transpose"), [self], {"axes": axes})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke(get_op("swapaxes"), [self], {"dim1": dim1, "dim2": dim2})
+
+    def expand_dims(self, axis):
+        return invoke(get_op("expand_dims"), [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke(get_op("squeeze"), [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return invoke(get_op("broadcast_to"), [self], {"shape": shape})
+
+    def broadcast_like(self, other):
+        return invoke(get_op("broadcast_like"), [self, other], {})
+
+    def sum(self, axis=None, keepdims=False):
+        return invoke(get_op("sum"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke(get_op("mean"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke(get_op("prod"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke(get_op("max"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke(get_op("min"), [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None):
+        return invoke(get_op("argmax"), [self], {"axis": axis})
+
+    def argmin(self, axis=None):
+        return invoke(get_op("argmin"), [self], {"axis": axis})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke(get_op("norm"), [self], {"ord": ord, "axis": axis,
+                                               "keepdims": keepdims})
+
+    def clip(self, a_min, a_max):
+        return invoke(get_op("clip"), [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return invoke(get_op("abs"), [self], {})
+
+    def sqrt(self):
+        return invoke(get_op("sqrt"), [self], {})
+
+    def square(self):
+        return invoke(get_op("square"), [self], {})
+
+    def exp(self):
+        return invoke(get_op("exp"), [self], {})
+
+    def log(self):
+        return invoke(get_op("log"), [self], {})
+
+    def sigmoid(self):
+        return invoke(get_op("sigmoid"), [self], {})
+
+    def tanh(self):
+        return invoke(get_op("tanh"), [self], {})
+
+    def relu(self):
+        return invoke(get_op("relu"), [self], {})
+
+    def softmax(self, axis=-1):
+        return invoke(get_op("softmax"), [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke(get_op("log_softmax"), [self], {"axis": axis})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke(get_op("take"), [self, indices], {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke(get_op("pick"), [self, index], {"axis": axis, "keepdims": keepdims})
+
+    def one_hot(self, depth, **kw):
+        return invoke(get_op("one_hot"), [self], {"depth": depth, **kw})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke(get_op("topk"), [self], {"axis": axis, "k": k,
+                                               "ret_typ": ret_typ,
+                                               "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke(get_op("sort"), [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke(get_op("argsort"), [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def flip(self, axis):
+        return invoke(get_op("reverse"), [self], {"axis": axis})
+
+    def tile(self, reps):
+        return invoke(get_op("tile"), [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke(get_op("repeat"), [self], {"repeats": repeats, "axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke(get_op("slice_axis"), [self], {"axis": axis, "begin": begin,
+                                                     "end": end})
+
+    def zeros_like(self):
+        return invoke(get_op("zeros_like"), [self], {})
+
+    def ones_like(self):
+        return invoke(get_op("ones_like"), [self], {})
+
+
+# ----------------------------------------------------------------------
+# Op dispatch
+# ----------------------------------------------------------------------
+
+def _wrap_outputs(op, raw, inputs_for_tape, vjp_fn, params):
+    multi = isinstance(raw, (tuple, list))
+    raws = list(raw) if multi else [raw]
+    outs = [NDArray(r) for r in raws]
+    if vjp_fn is not None:
+        node = autograd.TapeNode(inputs_for_tape, vjp_fn, len(raws),
+                                 name=op.name)
+        node._out_avals = [(tuple(r.shape), r.dtype) for r in raws]
+        ndiff = op.num_diff_outputs if op.num_diff_outputs is not None else len(raws)
+        for i, o in enumerate(outs):
+            if i < ndiff:
+                o._ag_node = node
+                o._ag_out_index = i
+    return outs if multi else outs[0]
+
+
+def invoke(op: Op, tensor_args, kwargs, out=None):
+    """Dispatch one op eagerly (reference: ``Imperative::Invoke`` in
+    ``src/imperative/imperative.cc``; shape/type inference + engine push
+    collapse into a single traced JAX call here)."""
+    kwargs = dict(kwargs)
+    kwargs.pop("name", None)
+    params = op.param_defaults()
+    for k, v in kwargs.items():
+        if k not in params and not any(p.name == k for p in op.params):
+            raise MXNetError("op %s: unknown argument %r" % (op.name, k))
+        params[k] = v
+    if any(p.name == "training" for p in op.params) and "training" not in kwargs:
+        params["training"] = autograd.is_training()
+
+    nds = []
+    datas = []
+    for a in tensor_args:
+        if a is None:
+            nds.append(None)
+            datas.append(None)
+        elif isinstance(a, NDArray):
+            nds.append(a)
+            datas.append(a._data)
+        else:
+            nd = NDArray(jnp.asarray(a))
+            nds.append(nd)
+            datas.append(nd._data)
+
+    fn = op.fcompute
+    if op.stateful_rng:
+        key = _random_mod.next_key()
+        fn = functools.partial(fn, key)
+
+    present = [i for i, d in enumerate(datas) if d is not None]
+    pdatas = [datas[i] for i in present]
+
+    def call(*pd):
+        full = list(datas)
+        for i, d in zip(present, pd):
+            full[i] = d
+        return fn(*full, **params)
+
+    recording = autograd.is_recording() and any(
+        n is not None and n._is_tracked() for n in nds)
+    if recording:
+        raw, vjp_fn = jax.vjp(call, *pdatas)
+        tape_inputs = [nds[i] for i in present]
+        result = _wrap_outputs(op, raw, tape_inputs, vjp_fn, params)
+    else:
+        raw = call(*pdatas)
+        result = _wrap_outputs(op, raw, None, None, params)
+
+    if out is not None:
+        src = result if not isinstance(result, list) else result[0]
+        out._data = src._data
+        out._ag_node = src._ag_node
+        out._ag_out_index = src._ag_out_index
+        return out
+    return result
+
+
+# ----------------------------------------------------------------------
+# Creation functions (reference: init_op.cc + ndarray.py module funcs)
+# ----------------------------------------------------------------------
+
+def _resolve_ctx(ctx):
+    return ctx if ctx is not None else current_context()
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (reference: ``mx.nd.array``)."""
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    arr = np.asarray(source_array)
+    if dtype is None:
+        dtype = np.float32 if arr.dtype == np.float64 else arr.dtype
+    arr = arr.astype(dtype)
+    return NDArray(jax.device_put(arr, _resolve_ctx(ctx).jax_device()))
+
+
+def from_jax(x):
+    return NDArray(x)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.zeros(shape, np.dtype(dtype)),
+                                  _resolve_ctx(ctx).jax_device()))
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.ones(shape, np.dtype(dtype)),
+                                  _resolve_ctx(ctx).jax_device()))
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jax.device_put(jnp.full(shape, val, np.dtype(dtype)),
+                                  _resolve_ctx(ctx).jax_device()))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, np.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(jax.device_put(out, _resolve_ctx(ctx).jax_device()))
+
+
+def moveaxis(data, source, destination):
+    return NDArray(jnp.moveaxis(data._data, source, destination))
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[-1]
+    res = invoke(get_op("one_hot"), [indices], {"depth": depth})
+    out._data = res._data
+    return out
+
+
+def concat(*data, dim=1):
+    return invoke(get_op("Concat"), list(data), {"dim": dim})
+
+
+def concatenate(arrays, axis=0):
+    return invoke(get_op("Concat"), list(arrays), {"dim": axis})
+
+
+# ----------------------------------------------------------------------
+# Serialization: the reference's .params container
+# (reference: src/ndarray/ndarray.cc :: NDArray::Save/Load, magic numbers
+# kMXAPINDArrayListMagic=0x112, NDARRAY_V2_MAGIC=0xF993FAC9).  Binary
+# layout follows the reference's dmlc::Stream order; exact byte-for-byte
+# compatibility could not be verified against the (empty) mount -- the
+# format below is self-consistent and documented.
+# ----------------------------------------------------------------------
+
+_LIST_MAGIC = 0x112
+_ND_MAGIC = 0xF993FAC9
+
+
+def _save_one(f, arr: NDArray):
+    a = arr.asnumpy()
+    f.write(struct.pack("<I", _ND_MAGIC))
+    f.write(struct.pack("<i", 0))  # storage type: dense
+    f.write(struct.pack("<I", a.ndim))
+    for d in a.shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<ii", 1, 0))  # dev_type=cpu, dev_id
+    f.write(struct.pack("<i", _MX_DTYPE_TO_FLAG[np.dtype(a.dtype)]))
+    buf = np.ascontiguousarray(a)
+    if buf.dtype == np.dtype(jnp.bfloat16.dtype):
+        f.write(buf.view(np.uint16).tobytes())
+    else:
+        f.write(buf.tobytes())
+
+
+def _load_one(f) -> NDArray:
+    magic, = struct.unpack("<I", f.read(4))
+    if magic != _ND_MAGIC:
+        raise MXNetError("bad NDArray magic 0x%x" % magic)
+    struct.unpack("<i", f.read(4))  # stype
+    ndim, = struct.unpack("<I", f.read(4))
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    struct.unpack("<ii", f.read(8))
+    flag, = struct.unpack("<i", f.read(4))
+    dtype = _FLAG_TO_MX_DTYPE[flag]
+    n = int(np.prod(shape)) if shape else 1
+    if flag == 100:
+        raw = np.frombuffer(f.read(n * 2), dtype=np.uint16).view(
+            np.dtype(jnp.bfloat16.dtype))
+    else:
+        raw = np.frombuffer(f.read(n * dtype.itemsize), dtype=dtype)
+    return NDArray(jnp.asarray(raw.reshape(shape)))
+
+
+def save(fname, data):
+    """Save NDArrays to the reference's ``.params`` container format
+    (reference: ``mx.nd.save`` / ``c_api.cc :: MXNDArraySave``)."""
+    if isinstance(data, NDArray):
+        data, names = [data], []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        data = [data[k] for k in names]
+    else:
+        data, names = list(data), []
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<Q", _LIST_MAGIC))
+        f.write(struct.pack("<Q", 0))
+        f.write(struct.pack("<Q", len(data)))
+        for arr in data:
+            _save_one(f, arr)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname):
+    """Load a ``.params`` container (reference: ``mx.nd.load``)."""
+    with open(fname, "rb") as f:
+        magic, = struct.unpack("<Q", f.read(8))
+        if magic != _LIST_MAGIC:
+            raise MXNetError("bad .params magic 0x%x" % magic)
+        struct.unpack("<Q", f.read(8))
+        count, = struct.unpack("<Q", f.read(8))
+        arrays = [_load_one(f) for _ in range(count)]
+        nnames, = struct.unpack("<Q", f.read(8))
+        names = []
+        for _ in range(nnames):
+            ln, = struct.unpack("<Q", f.read(8))
+            names.append(f.read(ln).decode("utf-8"))
+    if names:
+        return dict(zip(names, arrays))
+    return arrays
+
+
+def transpose(data, axes=None):
+    return invoke(get_op("transpose"), [data], {"axes": axes})

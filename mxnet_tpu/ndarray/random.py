@@ -1,0 +1,75 @@
+"""``mx.nd.random`` namespace (reference: ``python/mxnet/ndarray/random.py``)."""
+from __future__ import annotations
+
+from ..ops.registry import get_op
+from .ndarray import NDArray, invoke
+
+
+def _sample(opname, shape, ctx, dtype, **params):
+    if shape is None:
+        shape = ()
+    if isinstance(shape, int):
+        shape = (shape,)
+    out = invoke(get_op(opname), [], {"shape": tuple(shape), "dtype": dtype, **params})
+    if ctx is not None:
+        out = out.as_in_context(ctx)
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kwargs):
+    res = _sample("_random_uniform", shape, ctx, dtype, low=low, high=high)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kwargs):
+    res = _sample("_random_normal", shape, ctx, dtype, loc=loc, scale=scale)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kwargs):
+    return _sample("_random_gamma", shape, ctx, dtype, alpha=alpha, beta=beta)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **kwargs):
+    return _sample("_random_exponential", shape, ctx, dtype, lam=1.0 / scale)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kwargs):
+    return _sample("_random_poisson", shape, ctx, dtype, lam=lam)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kwargs):
+    return _sample("_random_negative_binomial", shape, ctx, dtype, k=k, p=p)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, **kwargs):
+    return _sample("_random_randint", shape, ctx, dtype, low=low, high=high)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kwargs):
+    params = {"get_prob": get_prob, "dtype": dtype}
+    if shape is not None:
+        params["shape"] = shape
+    return invoke(get_op("_sample_multinomial"), [data], params)
+
+
+def shuffle(data, **kwargs):
+    return invoke(get_op("_shuffle"), [data], {})
+
+
+def uniform_like(data, low=0.0, high=1.0):
+    return invoke(get_op("_random_uniform_like"), [data], {"low": low, "high": high})
+
+
+def normal_like(data, loc=0.0, scale=1.0):
+    return invoke(get_op("_random_normal_like"), [data], {"loc": loc, "scale": scale})

@@ -1,0 +1,67 @@
+"""Import-time codegen of the ``mx.nd.*`` function surface.
+
+TPU-native analog of the reference's ``python/mxnet/ndarray/register.py ::
+_make_ndarray_function``: for every registered op, synthesize a Python
+function whose keyword signature and docstring come from the op's typed
+parameter list (the dmlc::Parameter parity property).
+"""
+from __future__ import annotations
+
+import keyword
+
+from ..ops.registry import OP_REGISTRY
+from .ndarray import invoke
+
+
+_UNSET = object()  # sentinel: distinguishes "param not passed" so the
+# dispatcher can inject context-dependent defaults (e.g. training mode)
+
+
+def _make_function(op, pyname):
+    params = [p for p in op.params]
+    glb = {"_invoke": invoke, "_op": op, "_UNSET": _UNSET}
+    arg_bits = []
+    if op.variadic:
+        arg_bits.append("*data")
+        call_args = "list(data)"
+    else:
+        for a in op.arg_names:
+            arg_bits.append("%s=None" % a)
+        call_args = "[%s]" % ", ".join(op.arg_names)
+    kw_bits = []
+    for p in params:
+        nm = p.name + ("_" if keyword.iskeyword(p.name) else "")
+        kw_bits.append("%s=_UNSET" % nm)
+    sig = ", ".join(arg_bits + kw_bits + ["out=None", "name=None", "**kwargs"])
+    kw_fill = "\n".join(
+        "    if %s is not _UNSET: kwargs[%r] = %s"
+        % (p.name + ("_" if keyword.iskeyword(p.name) else ""), p.name,
+           p.name + ("_" if keyword.iskeyword(p.name) else ""))
+        for p in params)
+    src = (
+        "def %s(%s):\n"
+        "%s\n"
+        "    return _invoke(_op, %s, kwargs, out=out)\n"
+        % (pyname, sig, kw_fill or "    pass", call_args))
+    exec(compile(src, "<mxnet_tpu-op-gen>", "exec"), glb)
+    fn = glb[pyname]
+    fn.__doc__ = op.doc
+    fn.__module__ = "mxnet_tpu.ndarray"
+    return fn
+
+
+def populate(namespace):
+    """Generate one function per registered op name into ``namespace``."""
+    seen = {}
+    for name, op in OP_REGISTRY.items():
+        pyname = name if name.isidentifier() else None
+        if pyname is None:
+            continue
+        if pyname in namespace and not callable(namespace.get(pyname)):
+            continue
+        fn = seen.get(id(op))
+        if fn is None:
+            fn = _make_function(op, pyname)
+            seen[id(op)] = fn
+        namespace[pyname] = fn
+    return namespace

@@ -1,0 +1,10 @@
+"""Operator library (registry + definitions).
+
+Importing this package registers every op (reference: static registration
+in ``src/operator/*.cc`` via ``NNVM_REGISTER_OP``).
+"""
+from .registry import OP_REGISTRY, Op, OpParam, get_op, list_ops, register
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

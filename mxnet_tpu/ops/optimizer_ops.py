@@ -1,0 +1,218 @@
+"""Fused optimizer update operators.
+
+TPU-native re-design of ``src/operator/optimizer_op.cc`` (``sgd_update``,
+``sgd_mom_update``, ``mp_sgd*`` multi-precision, ``adam_update``,
+``lamb_update_phase1/2``, ``ftrl_update``, ``rmsprop_update`` ...).
+Functional contract: the reference mutates weight/state through the
+engine's mutable vars; here each op *returns* the updated tensors and the
+Python ``Optimizer``/``Trainer`` rebinds -- under jit the whole update
+fuses into one XLA computation with donated buffers, which is the TPU
+equivalent of the reference's single fused CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update", args=("weight", "grad"))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", args=("weight", "grad", "mom"))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", args=("weight", "grad", "mom"))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("mp_sgd_update", args=("weight", "grad", "weight32"))
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: fp32 master weights, low-precision model copy
+    (reference: ``optimizer_op.cc :: mp_sgd_update``)."""
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                  clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", args=("weight", "grad", "mom", "weight32"))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad,
+                  clip_gradient)
+    new_mom = momentum * mom - lr * g
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register("adam_update", args=("weight", "grad", "mean", "var"))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+    return w, m, v
+
+
+@register("adamw_update", args=("weight", "grad", "mean", "var"))
+def _adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """Decoupled weight decay Adam (reference: ``contrib/adamw.cc``)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight)
+    return w, m, v
+
+
+@register("rmsprop_update", args=("weight", "grad", "n"))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    n2 = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / (jnp.sqrt(n2) + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n2
+
+
+@register("rmspropalex_update", args=("weight", "grad", "n", "g", "delta"))
+def _rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    gr = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    n2 = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    g2 = gamma1 * g + (1 - gamma1) * gr
+    d2 = gamma2 * delta - lr * gr / jnp.sqrt(n2 - jnp.square(g2) + epsilon)
+    w = weight + d2
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n2, g2, d2
+
+
+@register("ftrl_update", args=("weight", "grad", "z", "n"))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n2 = n + jnp.square(g)
+    sigma = (jnp.sqrt(n2) - jnp.sqrt(n)) / lr
+    z2 = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z2) <= lamda1, jnp.zeros_like(weight),
+        -(z2 - jnp.sign(z2) * lamda1) / ((beta + jnp.sqrt(n2)) / lr + wd))
+    return w, z2, n2
+
+
+@register("adagrad_update", args=("weight", "grad", "history"),
+          aliases=("_sparse_adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h2 = history + jnp.square(g)
+    w = weight - lr * (g / jnp.sqrt(h2 + epsilon) + wd * weight)
+    return w, h2
+
+
+@register("signsgd_update", args=("weight", "grad"))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", args=("weight", "grad", "mom"))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom) - lr * wd * weight
+    return w, new_mom
+
+
+@register("lamb_update_phase1", args=("weight", "grad", "mean", "var"))
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """LAMB phase 1 (reference: ``optimizer_op.cc :: lamb_update_phase1``):
+    computes the raw update direction; phase 2 applies the trust ratio."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    gw = mh / (jnp.sqrt(vh) + epsilon) + wd * weight
+    return gw, m, v
+
+
+@register("lamb_update_phase2", args=("weight", "g", "r1", "r2"))
+def _lamb_update_phase2(weight, g, r1, r2, lr=0.001, lower_bound=-1.0,
+                        upper_bound=-1.0):
+    """LAMB phase 2: trust-ratio-scaled step (reference:
+    ``lamb_update_phase2``); r1=||w||, r2=||update||."""
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_or(r1 == 0, r2 == 0), 1.0, r1 / r2)
+    return weight - lr * ratio * g
+
+
+@register("multi_sum_sq", args=("data",), variadic=True)
+def _multi_sum_sq(*data, num_arrays=1):
+    """Per-array sum of squares (reference: ``multi_sum_sq.cc``; feeds
+    LARS trust-ratio computation)."""
+    return tuple(jnp.sum(jnp.square(a)).reshape(1) for a in data) \
+        if len(data) > 1 else jnp.sum(jnp.square(data[0])).reshape(1)
+
+
+@register("multi_all_finite", args=("data",), variadic=True)
+def _multi_all_finite(*data, num_arrays=1, init_output=True):
+    """AMP overflow check (reference: ``all_finite.cc``): 1 if every
+    element of every array is finite."""
+    ok = jnp.array(True)
+    for a in data:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.astype(jnp.float32).reshape(1)

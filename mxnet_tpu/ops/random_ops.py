@@ -1,0 +1,102 @@
+"""Random sampling operators.
+
+TPU-native re-design of ``src/operator/random/sample_op.cc`` and
+``multisample_op.cc``.  The reference draws from per-device ResourceManager
+RNG states (``src/resource.cc``); here every sampler is ``stateful_rng``:
+the dispatcher injects a fresh ``jax.random`` subkey split from the global
+stream (``mxnet_tpu/random.py``), keeping eager calls nondeterministic-free
+and jit traces reproducible (the key becomes an explicit input).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("_random_uniform", args=(), stateful_rng=True, aliases=("random_uniform",))
+def _random_uniform(key, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(key, shape, jnp.dtype(dtype), low, high)
+
+
+@register("_random_normal", args=(), stateful_rng=True, aliases=("random_normal", "normal"))
+def _random_normal(key, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(key, shape, jnp.dtype(dtype))
+
+
+@register("_random_gamma", args=(), stateful_rng=True)
+def _random_gamma(key, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(key, alpha, shape, jnp.dtype(dtype))
+
+
+@register("_random_exponential", args=(), stateful_rng=True)
+def _random_exponential(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(key, shape, jnp.dtype(dtype)) / lam
+
+
+@register("_random_poisson", args=(), stateful_rng=True)
+def _random_poisson(key, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(key, lam, shape).astype(jnp.dtype(dtype))
+
+
+@register("_random_negative_binomial", args=(), stateful_rng=True)
+def _random_negative_binomial(key, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(key)
+    g = jax.random.gamma(k1, k, shape) * (1 - p) / p
+    return jax.random.poisson(k2, g, shape).astype(jnp.dtype(dtype))
+
+
+@register("_random_randint", args=(), stateful_rng=True)
+def _random_randint(key, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(key, shape, low, high, jnp.dtype(dtype))
+
+
+@register("_sample_multinomial", args=("data",), stateful_rng=True,
+          aliases=("sample_multinomial",))
+def _sample_multinomial(key, data, shape=(), get_prob=False, dtype="int32"):
+    """Categorical sampling from probabilities (reference:
+    ``sample_multinomial_op.cc``); data: (..., k) probabilities.  With
+    ``get_prob=True`` also returns per-sample log-probabilities (the
+    REINFORCE pattern upstream documents)."""
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = shape if isinstance(shape, int) else (int(jnp.prod(jnp.array(shape))) if shape else 1)
+    sample_shape = (n,) if shape else ()
+    s = jax.random.categorical(key, logits, axis=-1,
+                               shape=sample_shape + data.shape[:-1])
+    if shape:
+        s = jnp.moveaxis(s, 0, -1)
+    s = s.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-37)) - jnp.log(
+            jnp.sum(data, axis=-1, keepdims=True))
+        picked = jnp.take_along_axis(
+            logp, s.astype(jnp.int32).reshape(data.shape[:-1] + (-1,)),
+            axis=-1).reshape(s.shape)
+        return s, picked
+    return s
+
+
+@register("_shuffle", args=("data",), stateful_rng=True, aliases=("shuffle",))
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_sample_unique_zipfian", args=(), stateful_rng=True)
+def _sample_unique_zipfian(key, range_max=1, shape=()):
+    u = jax.random.uniform(key, shape)
+    out = (jnp.exp(u * jnp.log(range_max + 1.0)) - 1.0).astype(jnp.int32)
+    return jnp.clip(out, 0, range_max - 1)
+
+
+def _like(name, base):
+    @register(name, args=("data",), stateful_rng=True)
+    def _op(key, data, low=0.0, high=1.0, loc=0.0, scale=1.0):
+        if base == "uniform":
+            return jax.random.uniform(key, data.shape, data.dtype, low, high)
+        return loc + scale * jax.random.normal(key, data.shape, data.dtype)
+    return _op
+
+
+_like("_random_uniform_like", "uniform")
+_like("_random_normal_like", "normal")

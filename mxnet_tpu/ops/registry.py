@@ -1,0 +1,135 @@
+"""Self-describing operator registry.
+
+TPU-native re-design of the reference's NNVM op registration + dmlc
+parameter system (reference: ``include/mxnet/op_attr_types.h :: FCompute``,
+``NNVM_REGISTER_OP`` in ``src/operator/``, ``3rdparty/dmlc-core/include/
+dmlc/parameter.h :: DMLC_DECLARE_PARAMETER``).
+
+Key differences from the reference, by design:
+
+- An op's compute function is a pure JAX function over ``jax.Array``s.  XLA
+  is the kernel library; there is no per-device FCompute dispatch table --
+  the same definition lowers to TPU (MXU/VPU) or CPU.
+- Gradients come from ``jax.vjp`` over the compute function, replacing the
+  reference's hand-written ``FGradient`` registrations, except where an op
+  registers a ``jax.custom_vjp`` itself (e.g. SoftmaxOutput).
+- Shape/type inference (``FInferShape``/``FInferType``) is
+  ``jax.eval_shape`` over the compute function -- exact by construction.
+- The typed parameter list is introspected from the compute function's
+  keyword signature, and Python wrappers for ``mx.nd.*`` / ``mx.sym.*`` are
+  generated from it at import time, preserving the reference's
+  self-describing API property (``python/mxnet/ndarray/register.py ::
+  _make_ndarray_function``).
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXNetError, build_param_doc
+
+__all__ = ["Op", "OpParam", "register", "get_op", "list_ops", "OP_REGISTRY"]
+
+
+@dataclass
+class OpParam:
+    """One typed config parameter of an op (dmlc::Parameter field analog)."""
+    name: str
+    default: Any = None
+    has_default: bool = True
+    doc: str = ""
+
+    @property
+    def type_str(self) -> str:
+        if self.default is None:
+            return "any"
+        return type(self.default).__name__
+
+
+@dataclass
+class Op:
+    """A registered operator.
+
+    ``fcompute(*tensor_args, **params) -> jax.Array | tuple`` is the single
+    source of truth: eager dispatch, jit tracing, vjp, and shape inference
+    all go through it.
+    """
+    name: str
+    fcompute: Callable
+    arg_names: Tuple[str, ...]
+    variadic: bool = False
+    params: List[OpParam] = field(default_factory=list)
+    doc: str = ""
+    aliases: Tuple[str, ...] = ()
+    # Number of leading tensor outputs that are differentiable; the rest
+    # (e.g. BatchNorm's updated running stats) are carried states.
+    num_diff_outputs: Optional[int] = None
+    # Ops flagged stateful_rng consume an implicit PRNG key (dropout, random
+    # samplers) -- the hybridize tracer threads a key input for them.
+    stateful_rng: bool = False
+
+    def param_defaults(self) -> Dict[str, Any]:
+        return {p.name: p.default for p in self.params if p.has_default}
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+OP_REGISTRY: Dict[str, Op] = {}
+
+
+def register(name: str, args: Sequence[str] = ("data",), variadic: bool = False,
+             aliases: Sequence[str] = (), num_diff_outputs: Optional[int] = None,
+             stateful_rng: bool = False):
+    """Decorator registering a JAX compute function as a framework op.
+
+    The decorated function's positional parameters must match ``args`` (the
+    tensor inputs; or ``*data`` when ``variadic``), and every keyword
+    parameter with a default becomes a typed op param surfaced in the
+    generated ``mx.nd.*`` signature and docstring.
+    """
+    def deco(fn: Callable) -> Op:
+        sig = inspect.signature(fn)
+        params = []
+        seen_args = []
+        for pname, p in sig.parameters.items():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                continue
+            if stateful_rng and pname == "key":
+                continue  # injected PRNG key, not a user-facing param
+            if p.default is inspect.Parameter.empty and pname in args:
+                seen_args.append(pname)
+                continue
+            if p.default is inspect.Parameter.empty and not variadic:
+                # required keyword param (e.g. shape for init ops)
+                params.append(OpParam(pname, None, has_default=False))
+            else:
+                params.append(OpParam(pname, p.default, has_default=True))
+        if not variadic and tuple(seen_args) != tuple(args):
+            raise MXNetError(
+                "op %s: positional args %r do not match declared %r"
+                % (name, seen_args, tuple(args)))
+        op = Op(name=name, fcompute=fn, arg_names=tuple(args),
+                variadic=variadic, params=params,
+                doc=inspect.getdoc(fn) or "", aliases=tuple(aliases),
+                num_diff_outputs=num_diff_outputs, stateful_rng=stateful_rng)
+        op.doc = (op.doc + "\n\n" + build_param_doc(params)) if params else op.doc
+        if name in OP_REGISTRY:
+            raise MXNetError("duplicate op registration: %s" % name)
+        OP_REGISTRY[name] = op
+        for a in aliases:
+            OP_REGISTRY.setdefault(a, op)
+        return op
+    return deco
+
+
+def get_op(name: str) -> Op:
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("unknown operator %r" % name) from None
+
+
+def list_ops() -> List[str]:
+    return sorted(OP_REGISTRY)

@@ -1,0 +1,698 @@
+"""Tensor operators: elementwise, broadcast, reduce, matrix, indexing, init.
+
+TPU-native re-design of the reference's ``src/operator/tensor/`` tree
+(``elemwise_binary_op_basic.cc``, ``elemwise_unary_op_basic.cc``,
+``broadcast_reduce_op_value.cc``, ``matrix_op.cc``, ``dot.cc``,
+``indexing_op.cc``, ``init_op.cc``, ``ordering_op.cc``).  Every op is a pure
+JAX function: XLA fuses elementwise chains and tiles dots onto the MXU, so
+there is no hand-written kernel layer (the reference's mshadow expression
+templates have no analog here -- ``jax.numpy`` *is* the expression
+language).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register
+
+# ----------------------------------------------------------------------
+# Elementwise binary (broadcasting, numpy semantics). The reference splits
+# exact-shape elemwise_* from explicit broadcast_* ops; both map here to the
+# same XLA HLO, so the broadcast_* names are aliases.
+# ----------------------------------------------------------------------
+
+def _binary(name, fn, aliases=()):
+    @register(name, args=("lhs", "rhs"), aliases=aliases)
+    def _op(lhs, rhs):
+        return fn(lhs, rhs)
+    _op.fcompute.__name__ = name
+    return _op
+
+
+_binary("elemwise_add", jnp.add, aliases=("broadcast_add", "broadcast_plus", "_plus"))
+_binary("elemwise_sub", jnp.subtract, aliases=("broadcast_sub", "broadcast_minus", "_minus"))
+_binary("elemwise_mul", jnp.multiply, aliases=("broadcast_mul", "_mul"))
+_binary("elemwise_div", jnp.divide, aliases=("broadcast_div", "_div"))
+_binary("broadcast_mod", jnp.mod, aliases=("_mod",))
+_binary("broadcast_power", jnp.power, aliases=("_power", "pow"))
+_binary("broadcast_maximum", jnp.maximum, aliases=("_maximum", "maximum"))
+_binary("broadcast_minimum", jnp.minimum, aliases=("_minimum", "minimum"))
+_binary("broadcast_hypot", jnp.hypot)
+_binary("broadcast_equal", lambda a, b: (a == b).astype(a.dtype), aliases=("_equal",))
+_binary("broadcast_not_equal", lambda a, b: (a != b).astype(a.dtype), aliases=("_not_equal",))
+_binary("broadcast_greater", lambda a, b: (a > b).astype(a.dtype), aliases=("_greater",))
+_binary("broadcast_greater_equal", lambda a, b: (a >= b).astype(a.dtype), aliases=("_greater_equal",))
+_binary("broadcast_lesser", lambda a, b: (a < b).astype(a.dtype), aliases=("_lesser",))
+_binary("broadcast_lesser_equal", lambda a, b: (a <= b).astype(a.dtype), aliases=("_lesser_equal",))
+_binary("broadcast_logical_and", lambda a, b: jnp.logical_and(a, b).astype(a.dtype))
+_binary("broadcast_logical_or", lambda a, b: jnp.logical_or(a, b).astype(a.dtype))
+_binary("broadcast_logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(a.dtype))
+_binary("arctan2", jnp.arctan2)
+_binary("ldexp", lambda a, b: a * (2.0 ** b))
+
+
+# ----------------------------------------------------------------------
+# Elementwise unary (reference: elemwise_unary_op_basic.cc, *_trig.cc).
+# ----------------------------------------------------------------------
+
+def _unary(name, fn, aliases=()):
+    @register(name, args=("data",), aliases=aliases)
+    def _op(data):
+        return fn(data)
+    return _op
+
+
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)  # fix == round-toward-zero
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("negative", jnp.negative)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("logical_not", lambda x: jnp.logical_not(x).astype(x.dtype))
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("identity", lambda x: x, aliases=("_copy", "stop_gradient_off"))
+
+
+@register("BlockGrad", args=("data",), aliases=("stop_gradient",))
+def _block_grad(data):
+    """Stop gradient flow (reference: ``elemwise_unary_op_basic.cc :: BlockGrad``)."""
+    return lax.stop_gradient(data)
+
+
+@register("Cast", args=("data",), aliases=("cast",))
+def _cast(data, dtype="float32"):
+    """Cast to a new dtype (reference: ``elemwise_unary_op_basic.cc :: Cast``)."""
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("clip", args=("data",))
+def _clip(data, a_min=0.0, a_max=1.0):
+    """Clip values to ``[a_min, a_max]`` (reference: ``matrix_op.cc :: clip``)."""
+    return jnp.clip(data, a_min, a_max)
+
+
+# scalar forms (reference: elemwise_binary_scalar_op*.cc)
+@register("_plus_scalar", args=("data",))
+def _plus_scalar(data, scalar=0.0):
+    return data + scalar
+
+
+@register("_minus_scalar", args=("data",))
+def _minus_scalar(data, scalar=0.0):
+    return data - scalar
+
+
+@register("_rminus_scalar", args=("data",))
+def _rminus_scalar(data, scalar=0.0):
+    return scalar - data
+
+
+@register("_mul_scalar", args=("data",))
+def _mul_scalar(data, scalar=1.0):
+    return data * scalar
+
+
+@register("_div_scalar", args=("data",))
+def _div_scalar(data, scalar=1.0):
+    return data / scalar
+
+
+@register("_rdiv_scalar", args=("data",))
+def _rdiv_scalar(data, scalar=1.0):
+    return scalar / data
+
+
+@register("_power_scalar", args=("data",))
+def _power_scalar(data, scalar=1.0):
+    return data ** scalar
+
+
+@register("_rpower_scalar", args=("data",))
+def _rpower_scalar(data, scalar=1.0):
+    return scalar ** data
+
+
+@register("_mod_scalar", args=("data",))
+def _mod_scalar(data, scalar=1.0):
+    return jnp.mod(data, scalar)
+
+
+@register("_maximum_scalar", args=("data",))
+def _maximum_scalar(data, scalar=0.0):
+    return jnp.maximum(data, scalar)
+
+
+@register("_minimum_scalar", args=("data",))
+def _minimum_scalar(data, scalar=0.0):
+    return jnp.minimum(data, scalar)
+
+
+# ----------------------------------------------------------------------
+# Reductions (reference: broadcast_reduce_op_value.cc). MXNet's `exclude`
+# kwarg reduces over all axes NOT listed.
+# ----------------------------------------------------------------------
+
+def _norm_axis(axis, ndim, exclude):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(a for a in range(ndim) if a not in axis)
+    return axis
+
+
+def _reduce(name, fn, aliases=()):
+    @register(name, args=("data",), aliases=aliases)
+    def _op(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return fn(data, axis=ax, keepdims=keepdims)
+    return _op
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm", args=("data",))
+def _norm(data, ord=2, axis=None, keepdims=False):
+    """Matrix/vector norm (reference: ``broadcast_reduce_op_value.cc :: norm``)."""
+    ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax", args=("data",))
+def _argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", args=("data",))
+def _argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("cumsum", args=("data",))
+def _cumsum(data, axis=None, dtype=None):
+    return jnp.cumsum(data, axis=axis, dtype=dtype)
+
+
+@register("logsumexp", args=("data",))
+def _logsumexp(data, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(data, axis=axis, keepdims=keepdims)
+
+
+# ----------------------------------------------------------------------
+# Matrix / shape ops (reference: matrix_op.cc, dot.cc).
+# ----------------------------------------------------------------------
+
+@register("dot", args=("lhs", "rhs"))
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Tensor dot product (reference: ``src/operator/tensor/dot.cc``).
+
+    2-D x 2-D is a plain matmul on the MXU; higher-rank follows MXNet
+    semantics: reduce over the last axis of ``lhs`` and first axis of
+    ``rhs``.
+    """
+    if transpose_a:
+        lhs = jnp.moveaxis(lhs, 0, -1) if lhs.ndim > 2 else lhs.T
+    if transpose_b:
+        rhs = jnp.moveaxis(rhs, -1, 0) if rhs.ndim > 2 else rhs.T
+    return jnp.tensordot(lhs, rhs, axes=1)
+
+
+@register("batch_dot", args=("lhs", "rhs"))
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Batched matmul (reference: ``dot.cc :: batch_dot``)."""
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register("transpose", args=("data",))
+def _transpose(data, axes=None):
+    if axes is None or (isinstance(axes, (tuple, list)) and len(axes) == 0):
+        return jnp.transpose(data)
+    return jnp.transpose(data, axes)
+
+
+@register("swapaxes", args=("data",), aliases=("SwapAxis",))
+def _swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+def _mx_reshape_infer(src_shape, target):
+    """Implement MXNet's reshape special codes 0, -1, -2, -3, -4.
+
+    Reference: ``matrix_op.cc :: ReshapeParam`` / ``InferReshapeShape``.
+    0: copy this dim from input; -1: infer; -2: copy all remaining dims;
+    -3: merge two consecutive input dims; -4: split one dim into the next
+    two target values.
+    """
+    out = []
+    src = list(src_shape)
+    i = 0  # position in src
+    t = 0
+    target = list(target)
+    while t < len(target):
+        v = target[t]
+        if v == 0:
+            out.append(src[i]); i += 1
+        elif v == -1:
+            out.append(-1); i += 1
+        elif v == -2:
+            out.extend(src[i:]); i = len(src)
+        elif v == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif v == -4:
+            a, b = target[t + 1], target[t + 2]
+            d = src[i]
+            if a == -1:
+                a = d // b
+            if b == -1:
+                b = d // a
+            out.extend([a, b]); i += 1; t += 2
+        else:
+            out.append(v); i += 1
+        t += 1
+    # resolve a single -1
+    if out.count(-1) > 1:
+        raise MXNetError("reshape: more than one -1 after code expansion")
+    return tuple(out)
+
+
+@register("Reshape", args=("data",), aliases=("reshape",))
+def _reshape(data, shape=(), reverse=False):
+    """Reshape with MXNet special codes (reference: ``matrix_op.cc :: Reshape``)."""
+    if reverse:
+        rshape = _mx_reshape_infer(data.shape[::-1], list(shape)[::-1])[::-1]
+    else:
+        rshape = _mx_reshape_infer(data.shape, shape)
+    return jnp.reshape(data, rshape)
+
+
+@register("reshape_like", args=("lhs", "rhs"))
+def _reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("shape_array", args=("data",))
+def _shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array", args=("data",))
+def _size_array(data):
+    return jnp.asarray([data.size], dtype=jnp.int32)
+
+
+@register("expand_dims", args=("data",))
+def _expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze", args=("data",))
+def _squeeze(data, axis=None):
+    return jnp.squeeze(data, axis)
+
+
+@register("Flatten", args=("data",), aliases=("flatten",))
+def _flatten(data):
+    """Collapse all but the first axis (reference: ``matrix_op.cc :: Flatten``)."""
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("reverse", args=("data",), aliases=("flip",))
+def _reverse(data, axis=0):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, ax)
+
+
+@register("tile", args=("data",))
+def _tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat", args=("data",))
+def _repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("Pad", args=("data",), aliases=("pad",))
+def _pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """N-D padding (reference: ``src/operator/pad.cc``); pad_width is the
+    flat MXNet form (before, after) per axis."""
+    pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+          for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register("slice", args=("data",))
+def _slice(data, begin=(), end=(), step=()):
+    """MXNet slice (reference: ``matrix_op.cc :: slice``); None in
+    begin/end means full extent."""
+    ndim = data.ndim
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step) + [None] * (ndim - len(step)) if step else [None] * ndim
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return data[idx]
+
+
+@register("slice_axis", args=("data",))
+def _slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like", args=("data", "shape_like"))
+def _slice_like(data, shape_like, axes=()):
+    axes = tuple(axes) if axes else tuple(range(data.ndim))
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("broadcast_to", args=("data",))
+def _broadcast_to(data, shape=()):
+    tgt = tuple(s if t == 0 else t for s, t in zip(data.shape, shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like", args=("lhs", "rhs"))
+def _broadcast_like(lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register("broadcast_axis", args=("data",), aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("Concat", args=("data",), variadic=True, aliases=("concat",))
+def _concat(*data, dim=1):
+    """Concatenate along ``dim`` (reference: ``src/operator/nn/concat.cc``)."""
+    return jnp.concatenate(data, axis=dim)
+
+
+@register("stack", args=("data",), variadic=True)
+def _stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register("split", args=("data",), aliases=("SliceChannel",))
+def _split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split into equal parts (reference: ``slice_channel.cc``)."""
+    outs = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs) if num_outputs > 1 else outs[0]
+
+
+@register("add_n", args=("args",), variadic=True, aliases=("ElementWiseSum",))
+def _add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("where", args=("condition", "x", "y"))
+def _where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("diag", args=("data",))
+def _diag(data, k=0):
+    return jnp.diag(data, k) if data.ndim <= 2 else jnp.diagonal(data, k)
+
+
+@register("L2Normalization", args=("data",))
+def _l2_normalization(data, eps=1e-10, mode="instance"):
+    """Reference: ``src/operator/l2_normalization.cc``."""
+    if mode == "instance":
+        ax = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=True) + eps)
+    return data / norm
+
+
+# ----------------------------------------------------------------------
+# Indexing (reference: indexing_op.cc).
+# ----------------------------------------------------------------------
+
+@register("take", args=("a", "indices"))
+def _take(a, indices, axis=0, mode="clip"):
+    """Reference: ``indexing_op.cc :: take``."""
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("pick", args=("data", "index"))
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick per-row elements by index (reference: ``indexing_op.cc :: pick``)."""
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", args=("indices",))
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd", args=("data", "indices"))
+def _gather_nd(data, indices):
+    """Reference: ``indexing_op.cc :: gather_nd``; indices shape (M, ...)."""
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", args=("data", "indices"))
+def _scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("boolean_mask", args=("data", "index"))
+def _boolean_mask(data, index, axis=0):
+    """Reference: ``contrib/boolean_mask.cc``. Note: output shape is
+    data-dependent; not jittable (use `where`-style masking under jit)."""
+    return jnp.compress(index.astype(bool), data, axis=axis)
+
+
+@register("SequenceMask", args=("data", "sequence_length"))
+def _sequence_mask(data, sequence_length, use_sequence_length=False, value=0.0, axis=0):
+    """Reference: ``src/operator/sequence_mask.cc`` (time-major by default;
+    with ``use_sequence_length=False`` the op is identity, as upstream)."""
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    bshape = [1] * data.ndim
+    bshape[axis] = maxlen
+    steps = steps.reshape(bshape)
+    lshape = [1] * data.ndim
+    lshape[1 - axis] = sequence_length.shape[0]
+    lens = sequence_length.reshape(lshape)
+    mask = steps < lens
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast", args=("data", "sequence_length"))
+def _sequence_last(data, sequence_length, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    batch = jnp.arange(data.shape[1 - axis])
+    if axis == 0:
+        return data[idx, batch]
+    return data[batch, idx]
+
+
+@register("SequenceReverse", args=("data", "sequence_length"))
+def _sequence_reverse(data, sequence_length, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    lens = sequence_length.astype(jnp.int32)
+    # reversed index per (time, batch): len-1-t when t < len else t
+    rev = jnp.where(steps[:, None] < lens[None, :],
+                    lens[None, :] - 1 - steps[:, None], steps[:, None])
+    batch = jnp.arange(data.shape[1])
+    if axis != 0:
+        raise MXNetError("SequenceReverse: only axis=0 (time-major) supported")
+    return data[rev, batch[None, :]]
+
+
+# ----------------------------------------------------------------------
+# Ordering (reference: ordering_op.cc).
+# ----------------------------------------------------------------------
+
+@register("sort", args=("data",))
+def _sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort", args=("data",))
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register("topk", args=("data",))
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Reference: ``ordering_op.cc :: topk``."""
+    neg = data if not is_ascend else -data
+    neg = jnp.moveaxis(neg, axis, -1)
+    vals, idx = lax.top_k(neg, k)
+    src_vals = jnp.moveaxis(data, axis, -1)
+    vals = jnp.take_along_axis(src_vals, idx, axis=-1)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        raise MXNetError("topk ret_typ='mask' not supported")
+    raise MXNetError("topk: bad ret_typ %r" % ret_typ)
+
+
+# ----------------------------------------------------------------------
+# Init ops (reference: init_op.cc). These take no tensor inputs.
+# ----------------------------------------------------------------------
+
+@register("_zeros", args=())
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_ones", args=())
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_full", args=())
+def _full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype))
+
+
+@register("_eye", args=())
+def _eye(N=1, M=0, k=0, dtype="float32"):
+    return jnp.eye(N, M if M else None, k, dtype=jnp.dtype(dtype))
+
+
+@register("_arange", args=())
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace", args=())
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register("zeros_like", args=("data",))
+def _zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like", args=("data",))
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like", args=("data",))
+def _full_like(data, fill_value=0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("arange_like", args=("data",))
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference: ``contrib/arange_like``; shape-polymorphic arange."""
+    if axis is None:
+        n = data.size
+        shape = data.shape
+    else:
+        n = data.shape[axis]
+        shape = (n,)
+    out = start + step * jnp.arange(n, dtype=data.dtype)
+    return out.reshape(shape)

@@ -1,0 +1,37 @@
+"""Global random state.
+
+Reference: ``python/mxnet/random.py :: seed`` and the per-device RNG
+resources of ``src/resource.cc :: ResourceManager``.  TPU-native design: a
+single counter-based ``jax.random`` key stream.  Eager op calls split a
+fresh subkey per call; hybridized graphs receive the key as an explicit
+traced input (so a compiled step function stays pure and reproducible).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get_key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Seed the global stream (reference: ``mx.random.seed``)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh subkey (one per stateful-rng op call)."""
+    key = _get_key()
+    _state.key, sub = jax.random.split(key)
+    return sub
+
+
+def current_key():
+    return _get_key()

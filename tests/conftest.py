@@ -1,0 +1,27 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual devices (reference test strategy SURVEY.md
+§4: cpu is the reference backend; multi-device paths are exercised the way
+the reference's nightly dist tests use local multi-process -- here via
+XLA's virtual host devices, which exercise the same Mesh/pjit sharding
+code that runs on a real v5e-8).
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Per-test deterministic seeding (reference:
+    ``tests/python/unittest/common.py :: with_seed``)."""
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,147 @@
+"""Autograd semantics (reference: ``tests/python/unittest/test_autograd.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_record_pause():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        y = x * 2
+    y.backward()
+    assert x.grad.asnumpy().tolist() == [2, 2]
+
+
+def test_train_predict_mode():
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+        with autograd.train_mode():
+            assert autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_grad_req_add():
+    x = mx.nd.ones((3,))
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert x.grad.asnumpy().tolist() == [6, 6, 6]
+
+
+def test_grad_req_null():
+    x = mx.nd.ones((3,))
+    x.attach_grad(grad_req="null")
+    w = mx.nd.ones((3,))
+    w.attach_grad()
+    with autograd.record():
+        y = (x * w).sum()
+    y.backward()
+    assert w.grad.asnumpy().tolist() == [1, 1, 1]
+    assert x.grad.asnumpy().tolist() == [0, 0, 0]
+
+
+def test_multiple_use_accumulates():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x
+    y.backward()
+    assert x.grad.asscalar() == pytest.approx(5.0)
+
+
+def test_head_grad():
+    x = mx.nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(mx.nd.array([10., 100.]))
+    assert x.grad.asnumpy().tolist() == [30, 300]
+
+
+def test_detach_blocks():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert x.grad.asscalar() == pytest.approx(4.0)  # d(z)/dx = y = 4
+
+
+def test_block_grad_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.BlockGrad(x * x) * x
+    y.backward()
+    assert x.grad.asscalar() == pytest.approx(4.0)
+
+
+def test_deep_chain():
+    x = mx.nd.array([1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = x
+        for _ in range(30):
+            y = y * 1.1
+    y.backward()
+    assert x.grad.asscalar() == pytest.approx(1.1 ** 30, rel=1e-4)
+
+
+def test_autograd_grad_function():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, x)
+    assert g.asscalar() == pytest.approx(6.0)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.5, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+def test_backward_through_multiple_heads():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = x * 3
+    autograd.backward([a, b])
+    assert x.grad.asnumpy().tolist() == [5, 5]
+
+
+def test_error_outside_record():
+    x = mx.nd.ones((2,))
+    y = x * 2  # not recorded
+    with pytest.raises(Exception):
+        y.backward()

@@ -1,0 +1,160 @@
+"""NDArray API behavior (reference: ``tests/python/unittest/test_ndarray.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3) and a.dtype == np.float32
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = mx.nd.array([[1, 2], [3, 4]])
+    assert_almost_equal(c, np.array([[1, 2], [3, 4]], np.float32))
+    d = mx.nd.full((2, 2), 7.0)
+    assert d.asnumpy().ravel().tolist() == [7, 7, 7, 7]
+    e = mx.nd.arange(0, 10, 2)
+    assert e.asnumpy().tolist() == [0, 2, 4, 6, 8]
+
+
+def test_float64_downcast():
+    a = mx.nd.array(np.zeros((2, 2), dtype=np.float64))
+    assert a.dtype == np.float32
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    b = mx.nd.array([[10., 20.], [30., 40.]])
+    assert_almost_equal(a + b, [[11, 22], [33, 44]])
+    assert_almost_equal(b - a, [[9, 18], [27, 36]])
+    assert_almost_equal(a * 2 + 1, [[3, 5], [7, 9]])
+    assert_almost_equal(1 / a, [[1, .5], [1 / 3, .25]])
+    assert_almost_equal(a ** 2, [[1, 4], [9, 16]])
+    assert_almost_equal(-a, [[-1, -2], [-3, -4]])
+
+
+def test_inplace_ops():
+    a = mx.nd.ones((2, 2))
+    a += 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+    a *= 3
+    assert_almost_equal(a, np.full((2, 2), 6.0))
+    a /= 2
+    assert_almost_equal(a, np.full((2, 2), 3.0))
+    a -= 1
+    assert_almost_equal(a, np.full((2, 2), 2.0))
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1], np.arange(4, 8))
+    assert_almost_equal(a[0:2, 1], np.array([1, 5]))
+    idx = mx.nd.array([0, 2], dtype="int32")
+    assert_almost_equal(a[idx], np.arange(12).reshape(3, 4)[[0, 2]])
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 5.0
+    assert a.asnumpy()[1].tolist() == [5, 5, 5]
+    a[0, 0] = 1.0
+    assert a.asnumpy()[0, 0] == 1
+    a[:] = 2.0
+    assert (a.asnumpy() == 2).all()
+    b = mx.nd.ones((3,))
+    a[2] = b * 4
+    assert a.asnumpy()[2].tolist() == [4, 4, 4]
+
+
+def test_shape_methods():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape(0, -1).shape == (2, 12)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((0, 2, 1)).shape == (2, 4, 3)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert a.T.shape == (4, 3, 2)
+
+
+def test_mxnet_reshape_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((0, -3)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_reductions():
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert a.sum().asscalar() == 15
+    assert_almost_equal(a.sum(axis=0), [3, 5, 7])
+    assert_almost_equal(a.mean(axis=1), [1, 4])
+    assert a.max().asscalar() == 5
+    assert a.min().asscalar() == 0
+    assert a.argmax(axis=1).asnumpy().tolist() == [2, 2]
+    assert_almost_equal(a.norm(), np.sqrt((np.arange(6) ** 2).sum()), rtol=1e-4)
+
+
+def test_comparison():
+    a = mx.nd.array([1., 2., 3.])
+    b = mx.nd.array([2., 2., 2.])
+    assert (a == b).asnumpy().tolist() == [0, 1, 0]
+    assert (a > b).asnumpy().tolist() == [0, 0, 1]
+    assert (a <= b).asnumpy().tolist() == [1, 1, 0]
+
+
+def test_scalar_conversion():
+    assert float(mx.nd.array([3.5])) == 3.5
+    assert int(mx.nd.array([3])) == 3
+    assert mx.nd.array([[7.0]]).asscalar() == 7.0
+    with pytest.raises(Exception):
+        mx.nd.ones((2, 2)).asscalar()
+
+
+def test_copy_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    assert a.context == mx.cpu(0)
+    b = a.copy()
+    b[:] = 0
+    assert (a.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu(0))
+    assert c is a
+
+
+def test_astype():
+    a = mx.nd.ones((2,), dtype="float32")
+    assert a.astype("int32").dtype == np.int32
+    assert a.astype(np.float16).dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "x.params")
+    d = {"w": mx.nd.array(np.random.randn(3, 4)),
+         "b": mx.nd.arange(0, 5, dtype="int32")}
+    mx.nd.save(fname, d)
+    ld = mx.nd.load(fname)
+    assert sorted(ld) == ["b", "w"]
+    assert_almost_equal(ld["w"], d["w"])
+    assert ld["b"].dtype == np.int32
+    mx.nd.save(fname, [mx.nd.ones((2,))])
+    lst = mx.nd.load(fname)
+    assert isinstance(lst, list) and len(lst) == 1
+
+
+def test_concat_stack():
+    a, b = mx.nd.ones((2, 3)), mx.nd.zeros((2, 3))
+    assert mx.nd.concat(a, b, dim=0).shape == (4, 3)
+    assert mx.nd.concat(a, b, dim=1).shape == (2, 6)
+    assert mx.nd.stack(a, b, axis=0).shape == (2, 2, 3)
+
+
+def test_waitall():
+    a = mx.nd.ones((8, 8))
+    for _ in range(5):
+        a = mx.nd.dot(a, a)
+    mx.nd.waitall()
+    a.wait_to_read()

@@ -1,0 +1,368 @@
+"""Operator numeric correctness (reference:
+``tests/python/unittest/test_operator.py`` -- numpy-reference checks +
+finite-difference gradient checks via the ported test_utils contract)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_consistency)
+
+
+def test_elemwise_vs_numpy():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(3, 4).astype(np.float32)
+    na, nb = mx.nd.array(a), mx.nd.array(b)
+    assert_almost_equal(mx.nd.elemwise_add(na, nb), a + b)
+    assert_almost_equal(mx.nd.broadcast_mul(na, nb), a * b)
+    assert_almost_equal(mx.nd.maximum(na, nb), np.maximum(a, b))
+    assert_almost_equal(mx.nd.exp(na), np.exp(a), rtol=1e-5)
+    assert_almost_equal(mx.nd.sigmoid(na), 1 / (1 + np.exp(-a)), rtol=1e-5)
+    assert_almost_equal(mx.nd.relu(na), np.maximum(a, 0))
+    assert_almost_equal(mx.nd.tanh(na), np.tanh(a), rtol=1e-5)
+    assert_almost_equal(mx.nd.square(na), a * a, rtol=1e-5)
+    assert_almost_equal(mx.nd.abs(na), np.abs(a))
+
+
+def test_broadcasting():
+    a = np.random.randn(3, 1, 4).astype(np.float32)
+    b = np.random.randn(1, 5, 4).astype(np.float32)
+    assert_almost_equal(mx.nd.broadcast_add(mx.nd.array(a), mx.nd.array(b)), a + b)
+
+
+def test_dot():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True), a @ b,
+        rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a.T), mx.nd.array(b), transpose_a=True), a @ b,
+        rtol=1e-4)
+
+
+def test_batch_dot():
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    b = np.random.randn(2, 4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(a), mx.nd.array(b)),
+                        a @ b, rtol=1e-4)
+
+
+def test_fully_connected():
+    x = np.random.randn(2, 5).astype(np.float32)
+    w = np.random.randn(3, 5).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=3)
+    assert_almost_equal(out, x @ w.T + b, rtol=1e-4)
+
+
+def test_convolution_identity():
+    # 1x1 identity kernel must reproduce the input
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.zeros((2, 2, 1, 1), np.float32)
+    w[0, 0] = w[1, 1] = 1
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.zeros((2,)),
+                            kernel=(1, 1), num_filter=2)
+    assert_almost_equal(out, x, rtol=1e-5)
+
+
+def test_convolution_vs_manual():
+    x = np.random.randn(2, 3, 6, 6).astype(np.float32)
+    w = np.random.randn(4, 3, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                            kernel=(3, 3), num_filter=4, no_bias=True).asnumpy()
+    assert out.shape == (2, 4, 4, 4)
+    # brute-force reference at one location
+    manual = (x[0, :, 0:3, 0:3] * w[1]).sum()
+    assert abs(out[0, 1, 0, 0] - manual) < 1e-3
+
+
+def test_conv_grouped_strided():
+    x = np.random.randn(1, 4, 8, 8).astype(np.float32)
+    w = np.random.randn(4, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None, kernel=(3, 3),
+                            num_filter=4, num_group=2, stride=(2, 2),
+                            pad=(1, 1), no_bias=True)
+    assert out.shape == (1, 4, 4, 4)
+
+
+def test_deconvolution_shape():
+    x = mx.nd.random.normal(shape=(1, 3, 4, 4))
+    w = mx.nd.random.normal(shape=(3, 2, 3, 3))
+    out = mx.nd.Deconvolution(x, w, None, kernel=(3, 3), num_filter=2,
+                              stride=(2, 2), no_bias=True)
+    assert out.shape == (1, 2, 9, 9)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mp = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    assert_almost_equal(mp, [[[[5, 7], [13, 15]]]])
+    ap = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                       pool_type="avg")
+    assert_almost_equal(ap, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    gp = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max")
+    assert gp.shape == (1, 1, 1, 1) and gp.asscalar() == 15
+
+
+def test_batchnorm_train_stats():
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1
+    g = np.ones(3, np.float32)
+    b = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    out, nm, nv = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                                  mx.nd.array(mm), mx.nd.array(mv),
+                                  fix_gamma=False, training=True, momentum=0.9)
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(o.std(axis=(0, 2, 3)) - 1).max() < 1e-3
+    expect_m = 0.1 * x.mean(axis=(0, 2, 3))
+    assert_almost_equal(nm, expect_m, rtol=1e-3, atol=1e-5)
+
+
+def test_batchnorm_inference_uses_moving():
+    x = np.random.randn(4, 2).astype(np.float32)
+    out, _, _ = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.ones((2,)), mx.nd.zeros((2,)),
+                                mx.nd.array([1., 2.]), mx.nd.array([4., 9.]),
+                                fix_gamma=False, training=False, axis=1)
+    expect = (x - [1, 2]) / np.sqrt(np.array([4, 9]) + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.randn(4, 10).astype(np.float32)
+    g = np.random.rand(10).astype(np.float32)
+    b = np.random.randn(10).astype(np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b))
+    mu = x.mean(-1, keepdims=True)
+    sd = np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x - mu) / sd * g + b, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax():
+    x = np.random.randn(3, 5).astype(np.float32)
+    s = mx.nd.softmax(mx.nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(s, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    assert_almost_equal(mx.nd.log_softmax(mx.nd.array(x)),
+                        np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_grad():
+    x = np.random.randn(4, 5).astype(np.float32)
+    label = np.array([0, 2, 4, 1], np.float32)
+    nx = mx.nd.array(x)
+    nx.attach_grad()
+    with autograd.record():
+        prob = mx.nd.SoftmaxOutput(nx, mx.nd.array(label))
+    prob.backward()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(nx.grad, p - onehot, rtol=1e-4, atol=1e-5)
+
+
+def test_take_embedding():
+    w = np.random.randn(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 1], np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 1]])
+    out2 = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(out2, w[[1, 3, 1]])
+
+
+def test_embedding_grad_scatter():
+    w = mx.nd.array(np.zeros((5, 2), np.float32) + 1)
+    idx = mx.nd.array([0, 0, 3], dtype="int32")
+    w.attach_grad()
+    with autograd.record():
+        out = mx.nd.Embedding(idx, w, input_dim=5, output_dim=2)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert g[0].tolist() == [2, 2]  # two gathers of row 0
+    assert g[3].tolist() == [1, 1]
+    assert g[1].tolist() == [0, 0]
+
+
+def test_pick_onehot_gathernd():
+    x = np.random.randn(3, 4).astype(np.float32)
+    idx = np.array([0, 2, 3], np.float32)
+    assert_almost_equal(mx.nd.pick(mx.nd.array(x), mx.nd.array(idx)),
+                        x[np.arange(3), idx.astype(int)])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=4).asnumpy()
+    assert (oh.argmax(-1) == idx).all()
+    ind = mx.nd.array(np.array([[0, 1], [1, 2]], np.float32))
+    assert_almost_equal(mx.nd.gather_nd(mx.nd.array(x), ind), x[[0, 1], [1, 2]])
+
+
+def test_slicing_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    nx = mx.nd.array(x)
+    assert_almost_equal(mx.nd.slice(nx, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(mx.nd.slice_axis(nx, axis=2, begin=1, end=3), x[:, :, 1:3])
+    y = np.zeros((2, 2, 2), np.float32)
+    assert mx.nd.slice_like(nx, mx.nd.array(y)).shape == (2, 2, 2)
+
+
+def test_ordering():
+    x = np.array([[3., 1., 2.], [0., 5., 4.]], np.float32)
+    nx = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sort(nx), np.sort(x))
+    assert_almost_equal(mx.nd.sort(nx, is_ascend=False), -np.sort(-x))
+    assert mx.nd.argsort(nx).asnumpy()[0].tolist() == [1, 2, 0]
+    vals, idx = mx.nd.topk(nx, k=2, ret_typ="both")
+    assert vals.asnumpy()[0].tolist() == [3, 2]
+    assert idx.asnumpy()[0].tolist() == [0, 2]
+
+
+def test_topk_grad_not_needed():
+    x = mx.nd.array([[3., 1., 2.]])
+    out = mx.nd.topk(x, k=1, ret_typ="value")
+    assert out.asscalar() == 3
+
+
+def test_where_clip():
+    c = mx.nd.array([1., 0., 1.])
+    x = mx.nd.array([1., 2., 3.])
+    y = mx.nd.array([10., 20., 30.])
+    assert mx.nd.where(c, x, y).asnumpy().tolist() == [1, 20, 3]
+    assert mx.nd.clip(x, 1.5, 2.5).asnumpy().tolist() == [1.5, 2, 2.5]
+
+
+def test_sequence_ops():
+    x = np.arange(24, dtype=np.float32).reshape(4, 2, 3)  # (T,N,C)
+    lens = np.array([2, 3], np.float32)
+    masked = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(lens), value=-1.0,
+                                use_sequence_length=True)
+    m = masked.asnumpy()
+    assert (m[2, 0] == -1).all() and (m[2, 1] != -1).all() and (m[3, 1] == -1).all()
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(lens),
+                              use_sequence_length=True)
+    assert_almost_equal(last, np.stack([x[1, 0], x[2, 1]]))
+    # default (no lengths) is identity / plain last / plain reverse
+    assert_almost_equal(mx.nd.SequenceMask(mx.nd.array(x)), x)
+    assert_almost_equal(mx.nd.SequenceLast(mx.nd.array(x)), x[-1])
+    assert_almost_equal(mx.nd.SequenceReverse(mx.nd.array(x)), x[::-1])
+
+
+def test_gradient_elemwise():
+    check_numeric_gradient(lambda a, b: (a * b + a).sum(),
+                           [np.random.randn(3, 3).astype(np.float32),
+                            np.random.randn(3, 3).astype(np.float32)])
+
+
+def test_gradient_dense():
+    x = np.random.randn(2, 4).astype(np.float32)
+    w = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(3).astype(np.float32)
+    check_numeric_gradient(
+        lambda xx, ww, bb: mx.nd.FullyConnected(xx, ww, bb, num_hidden=3).sum(),
+        [x, w, b])
+
+
+def test_gradient_conv():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    w = np.random.randn(2, 2, 3, 3).astype(np.float32) * 0.5
+    check_numeric_gradient(
+        lambda xx, ww: mx.nd.Convolution(xx, ww, None, kernel=(3, 3),
+                                         num_filter=2, no_bias=True).sum(),
+        [x, w], rtol=2e-2, atol=1e-3)
+
+
+def test_gradient_softmax_ce():
+    x = np.random.randn(3, 4).astype(np.float32)
+    check_numeric_gradient(
+        lambda xx: -(mx.nd.log_softmax(xx) *
+                     mx.nd.one_hot(mx.nd.array([0., 1., 2.]), depth=4)).sum(),
+        [x], rtol=2e-2)
+
+
+def test_check_consistency_cpu_tpu():
+    # On CPU-only runs this degenerates to a single-context check.
+    check_consistency("dot", [np.random.randn(3, 4).astype(np.float32),
+                              np.random.randn(4, 2).astype(np.float32)])
+
+
+def test_activation_variants():
+    x = np.random.randn(4, 4).astype(np.float32)
+    nx = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(nx, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(nx, act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(mx.nd.LeakyReLU(nx, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    elu = mx.nd.LeakyReLU(nx, act_type="elu", slope=1.0).asnumpy()
+    assert_almost_equal(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-4, atol=1e-6)
+
+
+def test_random_ops():
+    u = mx.nd.random.uniform(0, 1, shape=(1000,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    assert abs(u.asnumpy().mean() - 0.5) < 0.05
+    n = mx.nd.random.normal(0, 1, shape=(2000,))
+    assert abs(n.asnumpy().mean()) < 0.1
+    r = mx.nd.random.randint(0, 10, shape=(100,))
+    assert r.dtype == np.int32 and r.asnumpy().max() < 10
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert (a == b).all()
+
+
+def test_rnn_lstm_shapes_and_grad():
+    from mxnet_tpu.ops.nn import rnn_param_size
+    T, N, I, H = 4, 2, 3, 5
+    ps = rnn_param_size("lstm", I, H, 2, True)
+    data = mx.nd.random.normal(shape=(T, N, I))
+    params = mx.nd.random.normal(shape=(ps,), scale=0.1)
+    h0 = mx.nd.zeros((4, N, H))
+    c0 = mx.nd.zeros((4, N, H))
+    params.attach_grad()
+    with autograd.record():
+        out, hy, cy = mx.nd.RNN(data, params, h0, c0, state_size=H,
+                                num_layers=2, bidirectional=True, mode="lstm")
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (T, N, 2 * H)
+    assert hy.shape == (4, N, H)
+    assert float(mx.nd.abs(params.grad).sum().asscalar()) > 0
+
+
+def test_optimizer_ops():
+    w = np.random.randn(5).astype(np.float32)
+    g = np.random.randn(5).astype(np.float32)
+    out = mx.nd.sgd_update(mx.nd.array(w), mx.nd.array(g), lr=0.1)
+    assert_almost_equal(out, w - 0.1 * g, rtol=1e-5)
+    mom = np.zeros(5, np.float32)
+    w2, m2 = mx.nd.sgd_mom_update(mx.nd.array(w), mx.nd.array(g), mx.nd.array(mom),
+                                  lr=0.1, momentum=0.9)
+    assert_almost_equal(m2, -0.1 * g, rtol=1e-5)
+    assert_almost_equal(w2, w - 0.1 * g, rtol=1e-5)
+    m = np.zeros(5, np.float32)
+    v = np.zeros(5, np.float32)
+    w3, m3, v3 = mx.nd.adam_update(mx.nd.array(w), mx.nd.array(g), mx.nd.array(m),
+                                   mx.nd.array(v), lr=0.01)
+    assert_almost_equal(m3, 0.1 * g, rtol=1e-5)
+
+
+def test_all_finite():
+    good = mx.nd.ones((3,))
+    bad = mx.nd.array([1.0, np.inf, 0.0])
+    assert mx.nd.multi_all_finite(good).asscalar() == 1.0
+    assert mx.nd.multi_all_finite(good, bad).asscalar() == 0.0
+
+
+def test_cast_bf16():
+    x = mx.nd.ones((4,))
+    b = mx.nd.Cast(x, dtype="bfloat16")
+    assert str(b.dtype) == "bfloat16"
+    back = mx.nd.Cast(b, dtype="float32")
+    assert back.asnumpy().tolist() == [1, 1, 1, 1]
