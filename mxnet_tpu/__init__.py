@@ -14,6 +14,24 @@ Typical use mirrors the reference::
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+# Persistent XLA compilation cache: the imperative NDArray surface compiles
+# one tiny XLA program per (op, shape) pair; caching them on disk makes
+# every process after the first start hot.  (The reference's analog is
+# cuDNN autotune caching, MXNET_CUDNN_AUTOTUNE_DEFAULT.)
+if _os.environ.get("MXNET_TPU_COMPILATION_CACHE", "1") != "0":
+    import jax as _jax
+    _cache_dir = _os.environ.get(
+        "MXNET_TPU_COMPILATION_CACHE_DIR",
+        _os.path.expanduser("~/.cache/mxnet_tpu/xla"))
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass
+
 from . import base
 from .base import MXNetError
 from .context import (Context, cpu, cpu_pinned, current_context, gpu,
@@ -23,3 +41,19 @@ from . import ndarray
 from . import ndarray as nd
 from . import random
 from . import autograd
+from . import initializer
+from . import initializer as init
+from . import metric
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import symbol
+from . import symbol as sym
+from . import executor
+from .executor import Executor
+from . import gluon
+from . import kvstore
+from . import kvstore as kv
+from . import recordio
+from . import io
+from . import image
+from . import test_utils

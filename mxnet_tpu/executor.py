@@ -1,0 +1,122 @@
+"""Graph executor.
+
+TPU-native re-design of ``src/executor/graph_executor.cc ::
+GraphExecutor`` / ``python/mxnet/executor.py :: Executor``.  The nnvm
+passes (InferShape, PlanMemory, AttachOpExecs) collapse into one
+``jax.jit`` of the graph walk: XLA does buffer assignment, fusion, and
+scheduling.  Backward is the jitted vjp of the same function (replacing
+the nnvm Gradient pass), with ``grad_req`` write/add/null honored at the
+rebind step.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .symbol.symbol import _eval_symbol
+
+
+class Executor:
+    """Bound executor (reference: ``Executor.forward/backward/outputs``)."""
+
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.arg_names, args))
+        self.arg_dict = dict(args or {})
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self.arg_names, args_grad))
+        self.grad_dict = dict(args_grad or {})
+        if isinstance(grad_req, str):
+            self.grad_req = {n: grad_req for n in self.arg_names}
+        else:
+            self.grad_req = dict(grad_req)
+        self.aux_dict = dict(aux_states or {})
+        self.outputs = []
+        self._fwd_jit = None
+        self._fwdbwd_jit = None
+        self._vjp = None
+
+    def _pure(self, arg_vals):
+        class _W:
+            def __init__(self, d):
+                self._data = d
+        feed = {k: _W(v) for k, v in arg_vals.items()}
+        outs = _eval_symbol(self._symbol, feed)
+        return tuple(o._data for o in outs)
+
+    def forward(self, is_train=False, **kwargs):
+        """Run the graph (reference: ``GraphExecutor::RunOps``)."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown input %r" % k)
+            self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
+                else v
+        arg_vals = {k: v._data for k, v in self.arg_dict.items()}
+        if is_train:
+            grad_names = [n for n in self.arg_names
+                          if self.grad_req.get(n, "null") != "null"]
+
+            def split(av):
+                diff = {n: av[n] for n in grad_names}
+                nondiff = {n: av[n] for n in av if n not in diff}
+                return diff, nondiff
+
+            diff, nondiff = split(arg_vals)
+            if self._fwdbwd_jit is None:
+                def fwd(diff, nondiff):
+                    merged = dict(nondiff)
+                    merged.update(diff)
+                    return jax.vjp(lambda d: self._pure({**nondiff, **d}),
+                                   diff)
+                self._fwdbwd_jit = jax.jit(
+                    lambda d, nd: jax.vjp(
+                        lambda dd: self._pure({**nd, **dd}), d))
+                self._bwd_jit = jax.jit(lambda vjp, cts: vjp(cts))
+            outs, self._vjp = self._fwdbwd_jit(diff, nondiff)
+        else:
+            if self._fwd_jit is None:
+                self._fwd_jit = jax.jit(self._pure)
+            outs = self._fwd_jit(arg_vals)
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Reference: ``Executor.backward``; accumulates into the bound
+        grad arrays per grad_req."""
+        import jax.numpy as jnp
+        if self._vjp is None:
+            raise MXNetError("backward before forward(is_train=True)")
+        if out_grads is None:
+            cts = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g._data for g in out_grads]
+        (grads,) = self._bwd_jit(self._vjp, tuple(cts))
+        for name, g in grads.items():
+            req = self.grad_req.get(name, "null")
+            if req == "null" or name not in self.grad_dict:
+                continue
+            tgt = self.grad_dict[name]
+            if req == "add":
+                tgt._data = tgt._data + g
+            else:
+                tgt._data = g
+        self._vjp = None
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = v._data

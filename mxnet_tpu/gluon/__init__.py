@@ -1,0 +1,8 @@
+"""``mx.gluon`` (reference: ``python/mxnet/gluon/``)."""
+from . import loss, nn, parameter
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Constant, Parameter, ParameterDict
+from .trainer import Trainer
+from . import data  # noqa: F401
+from . import rnn  # noqa: F401
+from . import model_zoo  # noqa: F401

@@ -1,0 +1,7 @@
+"""``gluon.data`` (reference: ``python/mxnet/gluon/data/``)."""
+from .dataloader import DataLoader
+from .dataset import (ArrayDataset, Dataset, RecordFileDataset,
+                      SimpleDataset)
+from .sampler import (BatchSampler, IntervalSampler, RandomSampler, Sampler,
+                      SequentialSampler)
+from . import vision

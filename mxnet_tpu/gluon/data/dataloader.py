@@ -1,0 +1,113 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``).
+
+TPU-native design notes: the reference forks multiprocessing workers and
+ships batches through POSIX-shm cpu_shared NDArrays
+(``src/storage/cpu_shared_storage_manager.h``).  Here workers are a
+thread pool doing numpy-side decode/augment (the GIL is released inside
+numpy/PIL/jax host ops), batches stay host-side numpy until
+``as_in_context`` triggers one async host->device DMA -- overlap with
+compute comes from PJRT async dispatch, replacing the engine-ordered copy.
+A prefetch queue of ``prefetch`` batches double-buffers the device.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        return array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are mutually exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                        last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Ordered thread-pool pipeline with bounded prefetch."""
+        batches = list(self._batch_sampler)
+        results = {}
+        results_lock = threading.Lock()
+        results_ready = threading.Condition(results_lock)
+        work = queue.Queue()
+        for i, b in enumerate(batches):
+            work.put((i, b))
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    i, indices = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    out = self._make_batch(indices)
+                except Exception as e:  # propagate to consumer
+                    out = e
+                with results_ready:
+                    results[i] = out
+                    results_ready.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                with results_ready:
+                    while i not in results:
+                        results_ready.wait(self._timeout)
+                    out = results.pop(i)
+                if isinstance(out, Exception):
+                    raise out
+                yield out
+        finally:
+            stop.set()

@@ -1,0 +1,219 @@
+"""Vision transforms (reference:
+``python/mxnet/gluon/data/vision/transforms.py``).  Operate on HWC uint8
+or float NDArrays host-side (numpy), like the reference's cpu augment path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose(Sequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ``ToTensor``)."""
+
+    def forward(self, x):
+        a = _to_np(x).astype(np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return array(a)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32)
+        self._std = np.asarray(std, np.float32)
+
+    def forward(self, x):
+        a = _to_np(x)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return array((a - mean) / std)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return array(_to_np(x).astype(self._dtype))
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        a = _to_np(x)
+        w, h = self._size
+        out = jax.image.resize(jnp.asarray(a, jnp.float32),
+                               (h, w, a.shape[2]), "bilinear")
+        if a.dtype == np.uint8:
+            out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+        return NDArray(out)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        a = _to_np(x)
+        w, h = self._size
+        y0 = max((a.shape[0] - h) // 2, 0)
+        x0 = max((a.shape[1] - w) // 2, 0)
+        return array(a[y0:y0 + h, x0:x0 + w])
+
+
+class RandomResizedCrop(Block):
+    """Random area/aspect crop + resize (reference: ``RandomResizedCrop``,
+    the ImageNet train-time augmentation of BASELINE config 2)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        a = _to_np(x)
+        H, W = a.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * aspect)))
+            h = int(round(np.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                crop = a[y0:y0 + h, x0:x0 + w]
+                return Resize(self._size)(array(crop))
+        return Resize(self._size)(CenterCrop(min(H, W))(array(a)))
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+
+    def forward(self, x):
+        a = _to_np(x)
+        if self._pad:
+            p = self._pad
+            a = np.pad(a, ((p, p), (p, p), (0, 0)), mode="constant")
+        w, h = self._size
+        y0 = np.random.randint(0, max(a.shape[0] - h, 0) + 1)
+        x0 = np.random.randint(0, max(a.shape[1] - w, 0) + 1)
+        return array(a[y0:y0 + h, x0:x0 + w])
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        a = _to_np(x)
+        if np.random.rand() < 0.5:
+            a = a[:, ::-1]
+        return array(np.ascontiguousarray(a))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        a = _to_np(x)
+        if np.random.rand() < 0.5:
+            a = a[::-1]
+        return array(np.ascontiguousarray(a))
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        a = _to_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._b, self._b)
+        return array(np.clip(a * f, 0, 255))
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        a = _to_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._c, self._c)
+        mean = a.mean()
+        return array(np.clip((a - mean) * f + mean, 0, 255))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        a = _to_np(x).astype(np.float32)
+        f = 1.0 + np.random.uniform(-self._s, self._s)
+        gray = a.mean(axis=2, keepdims=True)
+        return array(np.clip(gray + (a - gray) * f, 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        for t in np.random.permutation(self._ts).tolist():
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: ``RandomLighting``)."""
+
+    _eigval = np.array([55.46, 4.794, 1.148], np.float32)
+    _eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha_std=0.05):
+        super().__init__()
+        self._std = alpha_std
+
+    def forward(self, x):
+        a = _to_np(x).astype(np.float32)
+        alpha = np.random.normal(0, self._std, 3).astype(np.float32)
+        rgb = (self._eigvec @ (alpha * self._eigval)).astype(np.float32)
+        return array(np.clip(a + rgb, 0, 255))

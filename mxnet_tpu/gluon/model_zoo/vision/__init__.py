@@ -1,0 +1,43 @@
+"""``gluon.model_zoo.vision`` (reference:
+``python/mxnet/gluon/model_zoo/vision/__init__.py :: get_model``)."""
+from ....base import MXNetError
+from .resnet import *  # noqa: F401,F403
+from .resnet import get_resnet
+from .alexnet import alexnet
+from .vgg import (vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn,
+                  vgg19_bn, get_vgg)
+from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
+                        mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75,
+                        mobilenet_v2_0_5, mobilenet_v2_0_25, get_mobilenet,
+                        get_mobilenet_v2)
+from .squeezenet import squeezenet1_0, squeezenet1_1
+from .densenet import densenet121, densenet161, densenet169, densenet201
+
+
+def get_model(name, **kwargs):
+    models = {
+        "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+        "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+        "resnet152_v1": resnet152_v1,
+        "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+        "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+        "resnet152_v2": resnet152_v2,
+        "alexnet": alexnet,
+        "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+        "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+        "vgg19_bn": vgg19_bn,
+        "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+        "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+        "mobilenetv2_1.0": mobilenet_v2_1_0,
+        "mobilenetv2_0.75": mobilenet_v2_0_75,
+        "mobilenetv2_0.5": mobilenet_v2_0_5,
+        "mobilenetv2_0.25": mobilenet_v2_0_25,
+        "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+        "densenet121": densenet121, "densenet161": densenet161,
+        "densenet169": densenet169, "densenet201": densenet201,
+    }
+    name = name.lower()
+    if name not in models:
+        raise MXNetError("model %r not in zoo; available: %s"
+                         % (name, sorted(models)))
+    return models[name](**kwargs)

@@ -1,0 +1,13 @@
+"""``gluon.nn`` (reference: ``python/mxnet/gluon/nn/``)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .activations import (ELU, GELU, SELU, Activation, LeakyReLU, PReLU,
+                          Swish)
+from .basic_layers import (BatchNorm, Dense, Dropout, Embedding, Flatten,
+                           GroupNorm, HybridLambda, HybridSequential,
+                           InstanceNorm, Lambda, LayerNorm, Sequential,
+                           SyncBatchNorm)
+from .conv_layers import (AvgPool1D, AvgPool2D, AvgPool3D, Conv1D,
+                          Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
+                          GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D,
+                          GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
+                          MaxPool1D, MaxPool2D, MaxPool3D, ReflectionPad2D)

@@ -1,0 +1,224 @@
+"""Convolution and pooling layers (reference:
+``python/mxnet/gluon/nn/conv_layers.py``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+
+def _tuplify(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(v)
+    return (v,) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="Convolution", adj=None, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "dilate": dilation,
+            "pad": padding, "num_filter": channels, "num_group": groups,
+            "layout": layout}
+        if adj is not None:
+            self._kwargs["adj"] = adj
+        self._op_name = op_name
+        self._act = activation
+        self._groups = groups
+        self._kernel = kernel_size
+        with self.name_scope():
+            if op_name == "Convolution":
+                wshape = (channels, in_channels // groups if in_channels else 0) \
+                    + tuple(kernel_size)
+            else:  # Deconvolution: (in, out/groups, *k)
+                wshape = (in_channels if in_channels else 0, channels // groups) \
+                    + tuple(kernel_size)
+            self.weight = self.params.get(
+                "weight", shape=wshape, init=weight_initializer,
+                allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def infer_shape(self, x):
+        c = x.shape[1]
+        if self._op_name == "Convolution":
+            self.weight.shape = (self._channels, c // self._groups) \
+                + tuple(self._kernel)
+        else:
+            self.weight.shape = (c, self._channels // self._groups) \
+                + tuple(self._kernel)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        op = getattr(F, self._op_name)
+        out = op(x, weight, bias, no_bias=bias is None, **self._kwargs)
+        if self._act:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
+                         _tuplify(padding, 1), _tuplify(dilation, 1), groups,
+                         layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
+                         _tuplify(padding, 2), _tuplify(dilation, 2), groups,
+                         layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 3), _tuplify(strides, 3),
+                         _tuplify(padding, 3), _tuplify(dilation, 3), groups,
+                         layout, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 2), _tuplify(strides, 2),
+                         _tuplify(padding, 2), _tuplify(dilation, 2), groups,
+                         layout, op_name="Deconvolution",
+                         adj=_tuplify(output_padding, 2), **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tuplify(kernel_size, 1), _tuplify(strides, 1),
+                         _tuplify(padding, 1), _tuplify(dilation, 1), groups,
+                         layout, op_name="Deconvolution",
+                         adj=_tuplify(output_padding, 1), **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 layout, count_include_pad=None, ceil_mode=False, **kwargs):
+        super().__init__(**kwargs)
+        if strides is None:
+            strides = pool_size
+        self._kwargs = {
+            "kernel": pool_size, "stride": strides, "pad": padding,
+            "global_pool": global_pool, "pool_type": pool_type,
+            "pooling_convention": "full" if ceil_mode else "valid"}
+        if count_include_pad is not None:
+            self._kwargs["count_include_pad"] = count_include_pad
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kwargs)
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 1),
+                         _tuplify(strides, 1) if strides is not None else None,
+                         _tuplify(padding, 1), False, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 2),
+                         _tuplify(strides, 2) if strides is not None else None,
+                         _tuplify(padding, 2), False, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, **kwargs):
+        super().__init__(_tuplify(pool_size, 3),
+                         _tuplify(strides, 3) if strides is not None else None,
+                         _tuplify(padding, 3), False, "max", layout,
+                         ceil_mode=ceil_mode, **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True, **kwargs):
+        super().__init__(_tuplify(pool_size, 1),
+                         _tuplify(strides, 1) if strides is not None else None,
+                         _tuplify(padding, 1), False, "avg", layout,
+                         count_include_pad, ceil_mode, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuplify(pool_size, 2),
+                         _tuplify(strides, 2) if strides is not None else None,
+                         _tuplify(padding, 2), False, "avg", layout,
+                         count_include_pad, ceil_mode, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True,
+                 **kwargs):
+        super().__init__(_tuplify(pool_size, 3),
+                         _tuplify(strides, 3) if strides is not None else None,
+                         _tuplify(padding, 3), False, "avg", layout,
+                         count_include_pad, ceil_mode, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, "max", layout, **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, "max", layout,
+                         **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), True, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), True, "avg", layout,
+                         **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        p = _tuplify(padding, 2)
+        self._pad_width = (0, 0, 0, 0, p[0], p[0], p[1], p[1])
+
+    def hybrid_forward(self, F, x):
+        return F.Pad(x, mode="reflect", pad_width=self._pad_width)

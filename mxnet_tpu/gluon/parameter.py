@@ -1,0 +1,351 @@
+"""Gluon Parameter / ParameterDict.
+
+TPU-native re-design of ``python/mxnet/gluon/parameter.py :: Parameter,
+ParameterDict``: deferred shape init, grad_req, lr_mult/wd_mult, cast for
+AMP.  Single-array storage (the reference keeps one copy per GPU context;
+here one jax.Array carries the device -- or a sharding, for the
+data-parallel Trainer, where `jax.sharding` replaces per-context lists).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .. import autograd, initializer
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd_mod
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter touched before its deferred shape was inferred
+    (reference: ``parameter.py :: DeferredInitializationError``)."""
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    """A weight/aux tensor of a Block (reference: ``Parameter``)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._allow_deferred_init = allow_deferred_init
+        self._data = None          # NDArray once initialized
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._trace_data = None    # NDArray wrapping a tracer during hybridize
+        self._sharding = None      # jax NamedSharding for data-parallel runs
+
+    # -- shape ---------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is not None and shape_is_known(self._shape):
+            if tuple(new_shape) != self._shape:
+                raise MXNetError(
+                    "cannot reset shape of %s from %s to %s"
+                    % (self.name, self._shape, new_shape))
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError("bad grad_req %r" % req)
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+            else:
+                self._init_grad()
+
+    # -- init ----------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Reference: ``Parameter.initialize`` -- allocates + fills data,
+        or defers until the shape is known."""
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or initializer.Uniform()
+        ctx = ctx or current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # single jax.Array carries placement; list kept for API compat
+        if not shape_is_known(self._shape):
+            if not self._allow_deferred_init:
+                raise MXNetError(
+                    "cannot initialize %s: shape %s unknown and deferred "
+                    "init not allowed" % (self.name, self._shape))
+            self._deferred_init = (init, ctx, default_init)
+            return
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = _nd_mod.zeros(self._shape, ctx=ctx, dtype=self.dtype)
+        ini = init or self.init or default_init
+        if not isinstance(ini, initializer.Initializer):
+            ini = initializer.create(ini)
+        ini(initializer.InitDesc(self.name), data)
+        self._data = data
+        self._deferred_init = None
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not shape_is_known(self._shape):
+            raise DeferredInitializationError(
+                "parameter %s has unknown shape %s" % (self.name, self._shape))
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    def _init_grad(self):
+        self._data.attach_grad(self._grad_req)
+
+    # -- access --------------------------------------------------------
+    def _check_initialized(self):
+        if self._trace_data is not None:
+            return
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    "parameter %s deferred; forward once or set shape"
+                    % self.name)
+            raise MXNetError(
+                "parameter %s not initialized; call .initialize()" % self.name)
+
+    def data(self, ctx=None):
+        if self._trace_data is not None:
+            return self._trace_data
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    @property
+    def grad_or_none(self):
+        return None if self._data is None else self._data._grad
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._data._grad is None:
+            raise MXNetError(
+                "parameter %s has grad_req='null'" % self.name)
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            g = self._data._grad
+            g._data = _nd_mod.zeros(g.shape, dtype=g.dtype)._data
+
+    def set_data(self, data):
+        """Rebind the parameter value.  During hybridize tracing, aux-state
+        writes (e.g. BatchNorm running stats) are captured by the trace
+        context instead (reference mutates aux vars through the engine)."""
+        from .block import _active_trace
+        tr = _active_trace()
+        if tr is not None and isinstance(data, NDArray) and \
+                _nd_mod._is_traced(data._data):
+            tr.record_aux(self, data)
+            self._trace_data = data
+            return
+        if self._data is None:
+            if self._deferred_init is not None:
+                self.shape = data.shape
+                self._finish_deferred_init()
+            else:
+                raise MXNetError("parameter %s not initialized" % self.name)
+        grad = self._data._grad
+        req = self._data._grad_req
+        self._data = data if isinstance(data, NDArray) else NDArray(data)
+        self._data._grad = grad
+        self._data._grad_req = req
+
+    def cast(self, dtype):
+        """AMP cast (reference: ``Parameter.cast``)."""
+        self.dtype = np.dtype(dtype)
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data = self._data.astype(dtype)
+            if had_grad:
+                self._init_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(
+                ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+
+    def var(self):
+        from ..symbol import var
+        return var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def _reduce(self):
+        return self.data()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (
+            self.name, self._shape, self.dtype)
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference: ``Constant``)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = _nd_mod.array(value)
+        self.value = value
+
+        class _CInit(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                arr[:] = value
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit())
+
+
+class ParameterDict:
+    """Prefix-scoped dictionary of Parameters (reference:
+    ``ParameterDict``); ``get`` creates-or-shares."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        return "ParameterDict(%s)" % ", ".join(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        full = self._prefix + name
+        if full in self._params:
+            param = self._params[full]
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    if param.shape is None or not shape_is_known(param.shape):
+                        param._shape = tuple(v) if not isinstance(v, int) else (v,)
+                continue
+            return param
+        if self._shared is not None and full in self._shared._params:
+            self._params[full] = self._shared._params[full]
+            return self._params[full]
+        param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("duplicate parameter name %s" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        default = init or initializer.Uniform()
+        for p in self.values():
+            p.initialize(None, ctx, default, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def save(self, filename, strip_prefix=""):
+        arg = {}
+        for p in self.values():
+            name = p.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p._reduce()
+        _nd_mod.save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = _nd_mod.load(filename)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise MXNetError("parameter %s missing from file" % name)
+        for name, data in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError("unknown parameter %s in file" % name)
+                continue
+            p = self._params[name]
+            if p._data is None:
+                p._shape = data.shape
+                p.dtype = data.dtype
+                p._deferred_init = None
+                p._data = data.as_in_context(
+                    (ctx[0] if isinstance(ctx, (list, tuple)) else ctx)
+                    or current_context())
+                if p._grad_req != "null":
+                    p._init_grad()
+            else:
+                p.set_data(data.astype(p.dtype))

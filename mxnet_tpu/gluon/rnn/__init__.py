@@ -1,0 +1,4 @@
+"""``gluon.rnn`` (reference: ``python/mxnet/gluon/rnn/``)."""
+from .rnn_cell import (DropoutCell, GRUCell, LSTMCell, RecurrentCell,
+                       RNNCell, SequentialRNNCell, ZoneoutCell)
+from .rnn_layer import GRU, LSTM, RNN

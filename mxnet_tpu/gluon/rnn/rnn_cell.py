@@ -1,0 +1,207 @@
+"""Unrolled RNN cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        return [F.zeros(info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll over time (reference: ``RecurrentCell.unroll``)."""
+        from ... import ndarray as F
+        axis = layout.find("T")
+        batch = inputs.shape[1 - axis if axis <= 1 else 0]
+        if begin_state is None:
+            begin_state = self.begin_state(batch, dtype=inputs.dtype)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            step = F.squeeze(F.slice_axis(inputs, axis=axis, begin=t, end=t + 1),
+                             axis=axis)
+            out, states = self(step, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = F.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._act = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size))
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init="zeros")
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._act)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size))
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        gates = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                                 num_hidden=4 * self._hidden_size) + \
+            F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                             num_hidden=4 * self._hidden_size)
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = F.tanh(g)
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size))
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        ir, iz, inn = F.split(i2h, num_outputs=3, axis=-1)
+        hr, hz, hn = F.split(h2h, num_outputs=3, axis=-1)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = F.tanh(inn + r * hn)
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self._children[str(len(self._children))] = cell
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def __call__(self, inputs, states):
+        return self.forward(inputs, states)
+
+    def forward(self, inputs, states):
+        next_states = []
+        pos = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            out, new = cell(inputs, states[pos:pos + n])
+            inputs = out
+            pos += n
+            next_states.extend(new)
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        return F.Dropout(inputs, p=self._rate), states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def forward(self, inputs, states):
+        from ... import ndarray as F
+        out, new_states = self.base_cell(inputs, states)
+        if self._zo > 0:
+            mask = F.Dropout(F.ones_like(out), p=self._zo)
+            out = F.where(mask, out, out)
+        return out, new_states

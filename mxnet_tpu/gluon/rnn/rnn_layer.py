@@ -1,0 +1,161 @@
+"""Fused recurrent layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+LSTM/GRU/RNN over the fused ``RNN`` op (``ops/nn.py :: _rnn`` -- lax.scan
+over time).  Parameters follow the reference's per-layer naming
+(``l0_i2h_weight`` ...); they are packed into the fused op's flat vector
+inside the traced graph, so XLA sees one fused computation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import shape_is_known
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, gates, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError("layout must be TNC or NTC, got %r" % layout)
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = gates
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in (["l", "r"] if bidirectional else ["l"]):
+                    in_sz = input_size if i == 0 else hidden_size * self._dir
+                    self._reg_params["%s%d_i2h_weight" % (j, i)] = \
+                        self.params.get(
+                            "%s%d_i2h_weight" % (j, i),
+                            shape=(gates * hidden_size, in_sz),
+                            init=i2h_weight_initializer,
+                            allow_deferred_init=True)
+                    self._reg_params["%s%d_h2h_weight" % (j, i)] = \
+                        self.params.get(
+                            "%s%d_h2h_weight" % (j, i),
+                            shape=(gates * hidden_size, hidden_size),
+                            init=h2h_weight_initializer)
+                    self._reg_params["%s%d_i2h_bias" % (j, i)] = \
+                        self.params.get(
+                            "%s%d_i2h_bias" % (j, i),
+                            shape=(gates * hidden_size,),
+                            init=i2h_bias_initializer)
+                    self._reg_params["%s%d_h2h_bias" % (j, i)] = \
+                        self.params.get(
+                            "%s%d_h2h_bias" % (j, i),
+                            shape=(gates * hidden_size,),
+                            init=h2h_bias_initializer)
+
+    def infer_shape(self, x, *args):
+        in_sz = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                p = self._reg_params["%s%d_i2h_weight" % (j, i)]
+                if not shape_is_known(p.shape):
+                    layer_in = in_sz if i == 0 else \
+                        self._hidden_size * self._dir
+                    p.shape = (self._gates * self._hidden_size, layer_in)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as F
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(F.zeros(info["shape"], **kwargs))
+        return states
+
+    def _pack_params(self, F, kwargs):
+        chunks = []
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                for part in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    chunks.append(
+                        F.Reshape(kwargs["%s%d_%s" % (j, i, part)],
+                                  shape=(-1,)))
+        return F.Concat(*chunks, dim=0) if len(chunks) > 1 else chunks[0]
+
+    def hybrid_forward(self, F, inputs, states=None, **kwargs):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch, dtype=inputs.dtype)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        params = self._pack_params(F, kwargs)
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" else F.zeros_like(h0)
+        out = F.RNN(inputs, params, h0, c0, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout)
+        if self._mode == "lstm":
+            y, hy, cy = out
+            new_states = [hy, cy]
+        else:
+            y, hy = out
+            new_states = [hy]
+        if self._layout == "NTC":
+            y = F.swapaxes(y, dim1=0, dim2=1)
+        if skip_states:
+            return y
+        return y, new_states
+
+    def __repr__(self):
+        return "%s(%s, hidden=%d, layers=%d%s)" % (
+            type(self).__name__, self._input_size or "?", self._hidden_size,
+            self._num_layers, ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    """Vanilla multi-layer RNN (reference: ``rnn_layer.py :: RNN``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, mode, 1, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """Fused LSTM (reference: ``rnn_layer.py :: LSTM``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", 4, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape}, {"shape": shape}]
+
+
+class GRU(_RNNLayer):
+    """Fused GRU (reference: ``rnn_layer.py :: GRU``)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", 3, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]

@@ -1,0 +1,117 @@
+"""Gluon Trainer (reference: ``python/mxnet/gluon/trainer.py``).
+
+Applies an Optimizer to a set of Parameters after backward.  KVStore
+integration: gradients reduce across devices through the KVStore API
+(which on TPU is ICI collectives -- ``mxnet_tpu/kvstore.py``) before the
+update, preserving the reference's ``update_on_kvstore`` semantics.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a dict/list of Parameters")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError("non-Parameter in Trainer params: %r" % (p,))
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt.Optimizer):
+            self._optimizer = optimizer
+        else:
+            param_dict = {i: p for i, p in enumerate(self._params)}
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updater = opt.get_updater(self._optimizer)
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._kvstore_spec = kvstore
+        self._compression_params = compression_params
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _init_kvstore(self):
+        from .. import kvstore as kvs
+        spec = self._kvstore_spec
+        if spec is None:
+            self._kvstore = None
+        elif isinstance(spec, str):
+            self._kvstore = kvs.create(spec) if spec else None
+        else:
+            self._kvstore = spec
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(self._compression_params)
+        self._kv_initialized = True
+
+    def _check_and_rescale_grad(self, scale):
+        self._optimizer.rescale_grad = scale
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce (via kvstore/collectives) + optimizer update
+        (reference: ``Trainer.step``)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null" and p._data is not None \
+                    and p._data._grad is not None:
+                self._kvstore.pushpull(i, p._data._grad, out=p._data._grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if p._data._grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError("parameter %s has no gradient; run "
+                                 "backward first" % p.name)
+            self._updater(i, p._data._grad, p._data)
+
+    def save_states(self, fname):
+        """Reference: ``Trainer.save_states`` -- optimizer state blob."""
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())

@@ -1,0 +1,5 @@
+"""``mx.image`` (reference: ``python/mxnet/image/image.py``): host-side
+image IO and augmenters, PIL-backed (the reference uses OpenCV)."""
+from .image import (CastAug, CenterCropAug, ColorJitterAug, HorizontalFlipAug,
+                    ImageIter, RandomCropAug, ResizeAug, imdecode, imread,
+                    imresize, CreateAugmenter)

@@ -1,0 +1,237 @@
+"""Image IO + legacy ImageIter (reference: ``python/mxnet/image/image.py``).
+
+The reference decodes via OpenCV in C++ threads; here PIL does host-side
+decode (GIL released in the codec), and the DataLoader/iterator layer
+provides the threading.
+"""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file to an HWC uint8 NDArray (reference: ``imread``)."""
+    from PIL import Image
+    pil = Image.open(filename)
+    pil = pil.convert("RGB" if flag else "L")
+    arr = np.asarray(pil)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return array(arr)
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    """Decode a compressed image buffer (reference: ``imdecode``)."""
+    from PIL import Image
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    pil = Image.open(io.BytesIO(bytes(buf)))
+    pil = pil.convert("RGB" if flag else "L")
+    arr = np.asarray(pil)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return array(arr)
+
+
+def imresize(src, w, h, interp=1):
+    import jax
+    import jax.numpy as jnp
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = jax.image.resize(jnp.asarray(a, jnp.float32), (h, w, a.shape[2]),
+                           "bilinear" if interp else "nearest")
+    if a.dtype == np.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    return NDArray(out)
+
+
+class Augmenter:
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size
+
+    def __call__(self, src):
+        a = src.asnumpy()
+        h, w = a.shape[:2]
+        if min(h, w) == self.size:
+            return src
+        if h > w:
+            new_w, new_h = self.size, int(h * self.size / w)
+        else:
+            new_w, new_h = int(w * self.size / h), self.size
+        return imresize(src, new_w, new_h)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, src):
+        a = src.asnumpy()
+        w, h = self.size
+        y0 = max((a.shape[0] - h) // 2, 0)
+        x0 = max((a.shape[1] - w) // 2, 0)
+        out = a[y0:y0 + h, x0:x0 + w]
+        if out.shape[:2] != (h, w):
+            return imresize(array(out), w, h)
+        return array(out)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, src):
+        a = src.asnumpy()
+        w, h = self.size
+        y0 = np.random.randint(0, max(a.shape[0] - h, 0) + 1)
+        x0 = np.random.randint(0, max(a.shape[1] - w, 0) + 1)
+        out = a[y0:y0 + h, x0:x0 + w]
+        if out.shape[:2] != (h, w):
+            return imresize(array(out), w, h)
+        return array(out)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return array(np.ascontiguousarray(src.asnumpy()[:, ::-1]))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    def __call__(self, src):
+        a = src.asnumpy().astype(np.float32)
+        if self.brightness:
+            a *= 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        if self.contrast:
+            f = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+            a = (a - a.mean()) * f + a.mean()
+        if self.saturation:
+            f = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+            gray = a.mean(axis=2, keepdims=True)
+            a = gray + (a - gray) * f
+        return array(np.clip(a, 0, 255))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference: ``CreateAugmenter``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    auglist.append(CastAug())
+    return auglist
+
+
+class ImageIter:
+    """Legacy image iterator over .rec or .lst (reference: ``ImageIter``).
+
+    Yields ``DataBatch``-like objects with CHW float data; sharding via
+    num_parts/part_index as the reference's distributed input contract.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", aug_list=None,
+                 shuffle=False, num_parts=1, part_index=0, label_width=1,
+                 **kwargs):
+        from ..recordio import MXIndexedRecordIO
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.shuffle = shuffle
+        self._rec = None
+        self._imglist = None
+        if path_imgrec:
+            idx_path = path_imgrec[:path_imgrec.rindex(".")] + ".idx"
+            self._rec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            keys = list(self._rec.keys)
+        elif path_imglist:
+            self._imglist = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    self._imglist.append(
+                        (float(parts[1]), os.path.join(path_root, parts[-1])))
+            keys = list(range(len(self._imglist)))
+        else:
+            raise MXNetError("need path_imgrec or path_imglist")
+        # distributed sharding (reference: num_parts/part_index kwargs)
+        self._keys = keys[part_index::num_parts]
+        self.reset()
+
+    def reset(self):
+        self._order = np.random.permutation(len(self._keys)) if self.shuffle \
+            else np.arange(len(self._keys))
+        self._cursor = 0
+
+    def _read_one(self, key):
+        from ..recordio import unpack_img
+        if self._rec is not None:
+            header, img = unpack_img(self._rec.read_idx(self._keys[key]))
+            label = header.label
+            img = array(img)
+        else:
+            label, path = self._imglist[self._keys[key]]
+            img = imread(path)
+        for aug in self.auglist:
+            img = aug(img)
+        a = img.asnumpy()
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        return a, label
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._cursor + self.batch_size > len(self._keys):
+            raise StopIteration
+        datas, labels = [], []
+        for i in range(self.batch_size):
+            a, l = self._read_one(self._order[self._cursor + i])
+            datas.append(a)
+            labels.append(np.atleast_1d(np.asarray(l, np.float32))[0])
+        self._cursor += self.batch_size
+        from ..io import DataBatch
+        return DataBatch(data=[array(np.stack(datas))],
+                         label=[array(np.asarray(labels))])
+
+    next = __next__

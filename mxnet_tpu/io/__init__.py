@@ -1,0 +1,3 @@
+"""``mx.io`` (reference: ``python/mxnet/io/io.py``)."""
+from .io import (DataBatch, DataDesc, DataIter, MNISTIter, NDArrayIter,
+                 PrefetchingIter, ResizeIter, ImageRecordIter, CSVIter)

@@ -1,0 +1,239 @@
+"""KVStore: the parameter-store API over XLA collectives.
+
+TPU-native re-design of the reference's ``src/kvstore/`` stack
+(``kvstore_local.h :: KVStoreLocal``, ``comm.h :: CommDevice``,
+``kvstore_dist.h :: KVStoreDist`` + ps-lite, ``kvstore_nccl.h``).
+
+Design (SURVEY.md §5 "Distributed communication backend"):
+
+- ``local`` / ``device`` / ``nccl``: single-process.  There are no
+  per-device gradient copies to reduce -- data-parallel gradients live as
+  ONE sharded jax.Array whose reduction happened *inside* the compiled
+  step via ``psum`` over ICI (see ``mxnet_tpu/parallel``).  Push/pull
+  therefore aggregates pushed versions and applies the optimizer, giving
+  the reference's ``update_on_kvstore`` semantics without a comm step.
+- ``dist_sync`` / ``dist_device_sync`` / ``dist_async``: multi-process.
+  ``jax.distributed`` + PJRT replace the ps-lite scheduler/Van; pushes
+  allreduce across processes over DCN/ICI collectives.  The "server-side
+  optimizer" of the reference (``kvstore_dist_server.h :: DataHandleEx``)
+  becomes a replicated update after the allreduce -- same contract
+  (workers see identical post-update weights), no server role needed.
+- Gradient compression hook mirrors ``gradient_compression.cc`` (2bit with
+  error feedback) as a pre-allreduce transform.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _allreduce_across_processes(x):
+    """Sum a host-local array across all jax processes (DCN path)."""
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(x)
+    return jnp.sum(gathered, axis=0)
+
+
+class _TwoBitCompression:
+    """2-bit gradient compression with error feedback (reference:
+    ``src/kvstore/gradient_compression.cc``)."""
+
+    def __init__(self, threshold=0.5):
+        self.threshold = float(threshold)
+        self._residual = {}
+
+    def compress_decompress(self, key, grad):
+        r = self._residual.get(key)
+        g = grad if r is None else grad + r
+        t = self.threshold
+        q = jnp.where(g >= t, t, jnp.where(g <= -t, -t, 0.0))
+        self._residual[key] = g - q
+        return q
+
+
+class KVStore:
+    """Reference: ``include/mxnet/kvstore.h :: KVStore`` /
+    ``python/mxnet/kvstore.py``."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}      # key -> NDArray (the "server" copy)
+        self._updater = None
+        self._optimizer = None
+        self._opt_states = {}
+        self._compression = None
+        self._is_dist = kv_type.startswith("dist")
+
+    # -- topology ------------------------------------------------------
+    @property
+    def rank(self):
+        return jax.process_index() if self._is_dist else 0
+
+    @property
+    def num_workers(self):
+        return jax.process_count() if self._is_dist else 1
+
+    # -- core API ------------------------------------------------------
+    def _keyify(self, key):
+        return key if isinstance(key, (str, int)) else str(key)
+
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        key = self._keyify(key)
+        if key in self._store:
+            return
+        self._store[key] = value.copy() if isinstance(value, NDArray) \
+            else NDArray(value)
+
+    def _merge(self, value):
+        """Sum a list of pushed values (the reference's CommDevice reduce)."""
+        if isinstance(value, (list, tuple)):
+            merged = value[0]._data
+            for v in value[1:]:
+                merged = merged + v._data
+            return merged
+        return value._data
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        key = self._keyify(key)
+        if key not in self._store:
+            raise MXNetError("kvstore key %r not initialized" % key)
+        merged = self._merge(value)
+        if self._compression is not None:
+            merged = self._compression.compress_decompress(key, merged)
+        if self._is_dist:
+            merged = _allreduce_across_processes(merged)
+        if self._updater is not None:
+            grad = NDArray(merged)
+            self._updater(key, grad, self._store[key])
+        else:
+            pending = getattr(self, "_pending", None)
+            if pending is None:
+                self._pending = pending = {}
+            pending[key] = merged if key not in pending \
+                else pending[key] + merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        key = self._keyify(key)
+        if key not in self._store:
+            raise MXNetError("kvstore key %r not initialized" % key)
+        pending = getattr(self, "_pending", {})
+        if self._updater is None and key in pending:
+            src = pending.pop(key)
+        else:
+            src = self._store[key]._data
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = src
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference: ``MXKVStorePushPullEx``).
+
+        Without an optimizer this is allreduce semantics on gradients:
+        out <- sum over workers(value).
+        """
+        if isinstance(key, (list, tuple)):
+            outs = out if out is not None else [None] * len(key)
+            for k, v, o in zip(key, value, outs):
+                self.pushpull(k, v, o, priority)
+            return
+        key = self._keyify(key)
+        merged = self._merge(value)
+        if self._compression is not None:
+            merged = self._compression.compress_decompress(key, merged)
+        if self._is_dist:
+            merged = _allreduce_across_processes(merged)
+        if self._updater is not None:
+            if key not in self._store:
+                raise MXNetError("kvstore key %r not initialized" % key)
+            self._updater(key, NDArray(merged), self._store[key])
+            result = self._store[key]._data
+        else:
+            result = merged
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._data = result
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull selected rows (reference: ``PullRowSparse``).  Dense
+        storage: gathers the requested rows."""
+        key = self._keyify(key)
+        if key not in self._store:
+            raise MXNetError("kvstore key %r not initialized" % key)
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        rows = row_ids._data.astype(jnp.int32) if isinstance(row_ids, NDArray) \
+            else jnp.asarray(row_ids, jnp.int32)
+        full = self._store[key]._data
+        picked = jnp.zeros_like(full).at[rows].set(full[rows])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = picked
+        return out
+
+    # -- optimizer on the store (reference: server-side optimizer) -----
+    def set_optimizer(self, optimizer):
+        """Reference: ``KVStore.set_optimizer`` -- pickles the optimizer to
+        servers; here it installs the updater on the replicated store."""
+        pickled = pickle.dumps(optimizer)  # keep the serialization contract
+        self._optimizer = pickle.loads(pickled)
+        self._updater = opt.get_updater(self._optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError("unsupported compression type %r" % ctype)
+        self._compression = _TwoBitCompression(
+            compression_params.get("threshold", 0.5))
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        if self._is_dist and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def create(name="local"):
+    """Reference: ``kvstore.create``; accepted types: local, device, nccl,
+    dist_sync, dist_device_sync, dist_async, dist."""
+    if name not in ("local", "device", "nccl", "dist", "dist_sync",
+                    "dist_async", "dist_device_sync", "horovod"):
+        raise MXNetError("unknown kvstore type %r" % name)
+    return KVStore(name)

@@ -235,9 +235,13 @@ class NDArray:
         if isinstance(key, tuple):
             key = tuple(k._data if isinstance(k, NDArray) else k for k in key)
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
-            v = jnp.asarray(value, dtype=self._data.dtype)
-            self._data = jnp.broadcast_to(v, self.shape) + jnp.zeros_like(self._data) \
-                if v.shape != self.shape else v
+            v = jnp.broadcast_to(jnp.asarray(value, dtype=self._data.dtype),
+                                 self.shape)
+            # keep the array on its committed device (a bare jnp.asarray
+            # would land on the default device)
+            if not _is_traced(self._data) and not _is_traced(v):
+                v = jax.device_put(v, next(iter(self._data.devices())))
+            self._data = v
         else:
             self._data = self._data.at[key].set(value)
 

@@ -6,14 +6,15 @@ from .ndarray import NDArray, invoke
 
 
 def _sample(opname, shape, ctx, dtype, **params):
+    from ..context import current_context
     if shape is None:
         shape = ()
     if isinstance(shape, int):
         shape = (shape,)
     out = invoke(get_op(opname), [], {"shape": tuple(shape), "dtype": dtype, **params})
-    if ctx is not None:
-        out = out.as_in_context(ctx)
-    return out
+    # follow the reference's placement contract: samples live on ctx
+    # (default: the current context), not wherever the RNG computed
+    return out.as_in_context(ctx if ctx is not None else current_context())
 
 
 def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kwargs):

@@ -1,0 +1,7 @@
+"""``mx.optimizer`` (reference: ``python/mxnet/optimizer/``)."""
+from .optimizer import (SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, Ftrl, LAMB,
+                        LARS, Signum, Optimizer, Updater, create, get_updater,
+                        register)
+from . import lr_scheduler
+from .lr_scheduler import (CosineScheduler, FactorScheduler, LRScheduler,
+                           MultiFactorScheduler, PolyScheduler)

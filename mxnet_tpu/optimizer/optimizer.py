@@ -1,0 +1,455 @@
+"""Optimizers (reference: ``python/mxnet/optimizer/optimizer.py``).
+
+Each optimizer's ``update`` dispatches to the fused update ops in
+``ops/optimizer_ops.py`` (the reference's ``src/operator/optimizer_op.cc``
+kernels).  Functional rebinding replaces in-place mutation: the returned
+weight/state arrays are written back into the caller's NDArrays, so under a
+compiled trainer step the whole update fuses into one XLA program.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (reference: ``Optimizer`` + ``create``)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        key = name.lower()
+        if key not in _OPT_REGISTRY:
+            raise MXNetError("unknown optimizer %r" % name)
+        return _OPT_REGISTRY[key](**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype(np.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            inner_state, w32 = state
+            g32 = grad.astype(np.float32)
+            self.update(index, w32, g32, inner_state)
+            weight._data = w32.astype(np.float16)._data
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+def create(name, **kwargs):
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision (reference: ``SGD``)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            w, m = nd.sgd_mom_update(weight, grad, state,
+                                     momentum=self.momentum, **kw)
+            weight._data, state._data = w._data, m._data
+        else:
+            weight._data = nd.sgd_update(weight, grad, **kw)._data
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            g = grad
+            if self.momentum != 0.0:
+                mom, w32 = state[0], state[1]
+                w, m, nw32 = nd.mp_sgd_mom_update(
+                    weight, g, mom, w32, momentum=self.momentum,
+                    **self._common_kwargs(index))
+                self._update_count(index)
+                weight._data, mom._data, w32._data = w._data, m._data, nw32._data
+            else:
+                _, w32 = state
+                w, nw32 = nd.mp_sgd_update(weight, g, w32,
+                                           **self._common_kwargs(index))
+                self._update_count(index)
+                weight._data, w32._data = w._data, nw32._data
+        else:
+            self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype(np.float32)
+            mom = nd.zeros(weight.shape, ctx=weight.context) \
+                if self.momentum != 0.0 else None
+            return (mom, w32)
+        return self.create_state(index, weight)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: ``NAG``)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            w, m = nd.nag_mom_update(weight, grad, state,
+                                     momentum=self.momentum, **kw)
+            weight._data, state._data = w._data, m._data
+        else:
+            weight._data = nd.sgd_update(weight, grad, **kw)._data
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr as the reference does
+        kw["lr"] *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        mean, var = state
+        w, m, v = nd.adam_update(weight, grad, mean, var, beta1=self.beta1,
+                                 beta2=self.beta2, epsilon=self.epsilon, **kw)
+        weight._data, mean._data, var._data = w._data, m._data, v._data
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference: ``contrib/optimizer :: AdamW``)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        kw["lr"] *= (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        mean, var = state
+        w, m, v = nd.adamw_update(weight, grad, mean, var, beta1=self.beta1,
+                                  beta2=self.beta2, epsilon=self.epsilon, **kw)
+        weight._data, mean._data, var._data = w._data, m._data, v._data
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        if self.centered:
+            return (z(), z(), z())
+        return z()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self.clip_weights is not None:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            w, n2, g2, d2 = nd.rmspropalex_update(
+                weight, grad, n, g, delta, gamma1=self.gamma1,
+                gamma2=self.gamma2, epsilon=self.epsilon, **kw)
+            weight._data, n._data, g._data, delta._data = \
+                w._data, n2._data, g2._data, d2._data
+        else:
+            w, n2 = nd.rmsprop_update(weight, grad, state, gamma1=self.gamma1,
+                                      epsilon=self.epsilon, **kw)
+            weight._data, state._data = w._data, n2._data
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        w, h = nd.adagrad_update(weight, grad, state,
+                                 epsilon=self.float_stable_eps, **kw)
+        weight._data, state._data = w._data, h._data
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        kw = self._common_kwargs(index)
+        w, z2, n2 = nd.ftrl_update(weight, grad, z, n, lamda1=self.lamda1,
+                                   beta=self.beta, **kw)
+        weight._data, z._data, n._data = w._data, z2._data, n2._data
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            w, m = nd.signum_update(weight, grad, state, momentum=self.momentum,
+                                    wd_lh=self.wd_lh, **kw)
+            weight._data, state._data = w._data, m._data
+        else:
+            weight._data = nd.signsgd_update(weight, grad, **kw)._data
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference: ``LAMB``,
+    ``optimizer_op.cc :: lamb_update_phase1/2``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context),
+                nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = {"wd": self._get_wd(index), "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        g, m, v = nd.lamb_update_phase1(weight, grad, mean, var,
+                                        beta1=self.beta1, beta2=self.beta2,
+                                        epsilon=self.epsilon, t=t,
+                                        bias_correction=self.bias_correction,
+                                        **kw)
+        r1 = weight.norm()
+        r2 = g.norm()
+        kw2 = {"lr": self._get_lr(index)}
+        if self.lower_bound is not None:
+            kw2["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw2["upper_bound"] = self.upper_bound
+        w = nd.lamb_update_phase2(weight, g, r1, r2, **kw2)
+        weight._data, mean._data, var._data = w._data, m._data, v._data
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling for large-batch SGD (reference:
+    v1.6 ``optimizer/contrib :: LARS`` via ``multi_lars``/``multi_sum_sq``;
+    BASELINE config 5)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        w_norm = float(weight.norm().asscalar())
+        g_norm = float((grad * self.rescale_grad).norm().asscalar())
+        if w_norm > 0 and g_norm > 0:
+            trust = self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
+        else:
+            trust = 1.0
+        kw = {"lr": lr * trust, "wd": wd, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        if state is not None:
+            w, m = nd.sgd_mom_update(weight, grad, state,
+                                     momentum=self.momentum, **kw)
+            weight._data, state._data = w._data, m._data
+        else:
+            weight._data = nd.sgd_update(weight, grad, **kw)._data
+
+
+class Updater:
+    """Maps (index, grad, weight) -> state bookkeeping + optimizer.update
+    (reference: ``get_updater``/``Updater`` -- the kvstore's server-side
+    update callable)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return ("nd", s.asnumpy())
+            if isinstance(s, (tuple, list)):
+                return ("tuple", [to_np(x) for x in s])
+            return ("raw", s)
+        payload = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((payload, self.optimizer))
+        return pickle.dumps(payload)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple) and len(data) == 2 and \
+                isinstance(data[1], Optimizer):
+            payload, self.optimizer = data
+        else:
+            payload = data
+
+        def from_np(s):
+            kind, val = s
+            if kind == "nd":
+                return nd.array(val)
+            if kind == "tuple":
+                return tuple(from_np(x) for x in val)
+            return val
+        self.states = {k: from_np(v) for k, v in payload.items()}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

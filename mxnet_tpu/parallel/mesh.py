@@ -1,0 +1,70 @@
+"""Device mesh utilities.
+
+TPU-native replacement for the reference's device topology layer
+(``src/kvstore/gpu_topology.h`` tree schedules, NCCL communicators):
+on TPU the ICI torus is addressed through a ``jax.sharding.Mesh`` and
+XLA emits the collectives, so "topology-aware scheduling" reduces to
+picking mesh axes (SURVEY.md §2.4).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec",
+           "local_devices", "default_mesh"]
+
+
+def local_devices(platform=None):
+    if platform:
+        try:
+            return [d for d in jax.devices() if d.platform == platform] or \
+                jax.devices(platform)
+        except RuntimeError:
+            return []
+    return jax.devices()
+
+
+def make_mesh(axes, devices=None):
+    """Build a Mesh from ``{'dp': 4, 'tp': 2}``-style axis sizes.
+
+    ``-1`` for one axis means "all remaining devices".  Axis order follows
+    insertion order; put the fastest-varying (innermost, highest-bandwidth)
+    axis last, as the scaling-book recipe recommends for ICI.
+    """
+    axes = OrderedDict(axes)
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise MXNetError("only one mesh axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if -1 in sizes:
+        if n % known:
+            raise MXNetError("cannot infer -1 axis: %d devices not divisible "
+                             "by %d" % (n, known))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise MXNetError("mesh wants %d devices, only %d available"
+                         % (total, n))
+    mesh_devices = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(mesh_devices, tuple(axes.keys()))
+
+
+_default_mesh = None
+
+
+def default_mesh():
+    """A 1-D data-parallel mesh over all devices (cached)."""
+    global _default_mesh
+    if _default_mesh is None or \
+            _default_mesh.devices.size != len(jax.devices()):
+        _default_mesh = make_mesh({"dp": -1})
+    return _default_mesh

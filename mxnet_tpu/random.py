@@ -26,8 +26,39 @@ def seed(seed_state, ctx="all"):
     _state.key = jax.random.PRNGKey(int(seed_state))
 
 
+class _TracedStream:
+    """Key stream used while tracing a hybridized graph: subkeys split
+    from an explicit traced key input, so the compiled function stays pure
+    and gets fresh randomness each call (the key is an argument)."""
+
+    def __init__(self, key):
+        self.key = key
+
+    def next(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+def traced_stream(key):
+    """Context manager installing a traced key stream (hybridize tracer)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = getattr(_state, "stream", None)
+        _state.stream = _TracedStream(key)
+        try:
+            yield _state.stream
+        finally:
+            _state.stream = prev
+    return _cm()
+
+
 def next_key():
     """Split and return a fresh subkey (one per stateful-rng op call)."""
+    stream = getattr(_state, "stream", None)
+    if stream is not None:
+        return stream.next()
     key = _get_key()
     _state.key, sub = jax.random.split(key)
     return sub

@@ -1,0 +1,206 @@
+"""RecordIO: the reference's packed-record file format.
+
+TPU-native port of ``python/mxnet/recordio.py :: MXRecordIO,
+MXIndexedRecordIO, IRHeader, pack/unpack, pack_img/unpack_img`` and the
+dmlc-core record framing (``3rdparty/dmlc-core/include/dmlc/recordio.h``):
+
+    [kMagic u32][(cflag<<29)|length u32][payload][pad to 4B]
+
+cflag: 0 = whole record, 1 = first chunk, 2 = middle, 3 = last -- records
+larger than one chunk are split; magic is escaped inside payloads by
+chunking.  ``.idx`` sidecar: "key\\toffset\\n" per record.
+
+A C++ fast path (``src/recordio_native.cc``) is used for bulk reads when
+built; this module is the reference implementation and fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import io
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+kMagic = 0xCED7230A
+_HEADER_FMT = "<IfQQ"  # flag, label, id, id2
+_HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference: ``MXRecordIO``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %r" % self.flag)
+
+    def close(self):
+        if self.record is not None:
+            self.record.close()
+            self.record = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.record.tell()
+
+    def write(self, buf):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        # single-chunk framing (cflag=0); large records are still one chunk
+        # since Python framing needn't split (the reader handles both)
+        self.record.write(struct.pack("<I", kMagic))
+        self.record.write(struct.pack("<I", len(buf) & ((1 << 29) - 1)))
+        self.record.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.record.write(b"\x00" * pad)
+
+    def read(self):
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        data = b""
+        while True:
+            hdr = self.record.read(8)
+            if len(hdr) < 8:
+                return None if not data else data
+            magic, lrec = struct.unpack("<II", hdr)
+            if magic != kMagic:
+                raise MXNetError("corrupt recordio: bad magic 0x%x" % magic)
+            cflag = lrec >> 29
+            length = lrec & ((1 << 29) - 1)
+            payload = self.record.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.record.read(pad)
+            data += payload
+            if cflag in (0, 3):
+                return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed random-access reader/writer (reference:
+    ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.isfile(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2:
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+        self.fidx = open(idx_path, "w") if flag == "w" else None
+
+    def close(self):
+        super().close()
+        if getattr(self, "fidx", None) is not None:
+            self.fidx.close()
+            self.fidx = None
+
+    def seek(self, idx):
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write("%s\t%d\n" % (str(key), pos))
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a header + payload into a record string (reference: ``pack``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_HEADER_FMT, 0, float(header.label), header.id,
+                          header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        hdr = struct.pack(_HEADER_FMT, label.size, 0.0, header.id, header.id2) \
+            + label.tobytes()
+    return hdr + s
+
+
+def unpack(s):
+    """Unpack a record into (IRHeader, payload) (reference: ``unpack``)."""
+    flag, label, id_, id2 = struct.unpack(_HEADER_FMT, s[:_HEADER_SIZE])
+    s = s[_HEADER_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array into a record (reference: ``pack_img``)."""
+    from PIL import Image
+    buf = io.BytesIO()
+    arr = np.asarray(img)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    if arr.ndim == 2:
+        pil = Image.fromarray(arr, "L")
+    else:
+        pil = Image.fromarray(arr[:, :, :3], "RGB")
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    kw = {"quality": quality} if fmt == "JPEG" else {}
+    pil.save(buf, fmt, **kw)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Decode a record into (IRHeader, HWC uint8 image array)."""
+    from PIL import Image
+    header, img_bytes = unpack(s)
+    pil = Image.open(io.BytesIO(img_bytes))
+    if iscolor:
+        pil = pil.convert("RGB")
+    else:
+        pil = pil.convert("L")
+    arr = np.asarray(pil)
+    if arr.ndim == 2 and iscolor:
+        arr = np.stack([arr] * 3, axis=-1)
+    return header, arr

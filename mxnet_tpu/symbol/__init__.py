@@ -1,0 +1,8 @@
+"""``mx.sym`` (reference: ``python/mxnet/symbol/``)."""
+import sys as _sys
+
+from .symbol import (Group, Symbol, Variable, load, load_json, var,
+                     _eval_symbol)
+from . import register as _register
+
+_register.populate(_sys.modules[__name__].__dict__)

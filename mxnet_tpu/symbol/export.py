@@ -1,0 +1,38 @@
+"""HybridBlock -> (-symbol.json, -NNNN.params) export (reference:
+``gluon/block.py :: HybridBlock.export``).
+
+The block's ``hybrid_forward`` is re-traced with ``F = mx.sym`` (the
+reference's dual-F contract), producing a graph over the shared op
+registry; parameters are saved with the reference's ``arg:``/``aux:`` key
+prefixes so ``SymbolBlock.imports`` and third-party loaders interoperate.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .. import ndarray as nd_mod
+
+
+def symbolic_forward(block, *input_syms):
+    """Run a block's forward in Symbol mode."""
+    return block(*input_syms)
+
+
+def export_block(block, path, epoch=0, input_names=("data",)):
+    from . import symbol as sym_api
+    from .symbol import var
+    inputs = [var(n) for n in input_names]
+    out = symbolic_forward(block, *inputs)
+    if isinstance(out, (list, tuple)):
+        from .symbol import Group
+        out = Group(list(out))
+    sym_file = "%s-symbol.json" % path
+    out.save(sym_file)
+    arg = {}
+    for p in block._all_params():
+        if p._data is None:
+            continue
+        prefix = "aux:" if p._grad_req == "null" else "arg:"
+        arg[prefix + p.name] = p.data()
+    params_file = "%s-%04d.params" % (path, epoch)
+    nd_mod.save(params_file, arg)
+    return sym_file, params_file

@@ -1,0 +1,60 @@
+"""Import-time codegen of the ``mx.sym.*`` surface (reference:
+``python/mxnet/symbol/register.py``): same registry as ``mx.nd``, but the
+generated functions build graph nodes instead of executing."""
+from __future__ import annotations
+
+import keyword
+
+from ..ops.registry import OP_REGISTRY
+from .symbol import Symbol, _make_node
+
+_UNSET = object()
+
+
+def _make_function(op, pyname):
+    params = list(op.params)
+    glb = {"_make_node": _make_node, "_op": op, "_UNSET": _UNSET,
+           "_Symbol": Symbol}
+    arg_bits = []
+    if op.variadic:
+        arg_bits.append("*data")
+        call_args = "list(data)"
+    else:
+        for a in op.arg_names:
+            arg_bits.append("%s=None" % a)
+        call_args = ("[a for a in (%s,) if a is not None]"
+                     % ", ".join(op.arg_names)) if op.arg_names else "[]"
+    kw_bits = []
+    for p in params:
+        nm = p.name + ("_" if keyword.iskeyword(p.name) else "")
+        kw_bits.append("%s=_UNSET" % nm)
+    sig = ", ".join(arg_bits + kw_bits + ["name=None", "attr=None",
+                                          "**kwargs"])
+    kw_fill = "\n".join(
+        "    if %s is not _UNSET: kwargs[%r] = %s"
+        % (p.name + ("_" if keyword.iskeyword(p.name) else ""), p.name,
+           p.name + ("_" if keyword.iskeyword(p.name) else ""))
+        for p in params)
+    src = (
+        "def %s(%s):\n"
+        "%s\n"
+        "    return _make_node(%r, %s, kwargs, name=name)\n"
+        % (pyname, sig, kw_fill or "    pass", op.name, call_args))
+    exec(compile(src, "<mxnet_tpu-sym-gen>", "exec"), glb)
+    fn = glb[pyname]
+    fn.__doc__ = op.doc
+    fn.__module__ = "mxnet_tpu.symbol"
+    return fn
+
+
+def populate(namespace):
+    seen = {}
+    for name, op in OP_REGISTRY.items():
+        if not name.isidentifier():
+            continue
+        fn = seen.get((id(op), name))
+        if fn is None:
+            fn = _make_function(op, name)
+            seen[(id(op), name)] = fn
+        namespace.setdefault(name, fn)
+    return namespace

@@ -1,0 +1,367 @@
+"""Symbol: declarative graph composition.
+
+TPU-native re-design of the reference's nnvm Symbol world
+(``3rdparty/tvm/nnvm :: nnvm::Graph/Node``, ``python/mxnet/symbol/
+symbol.py``).  A Symbol is a DAG of op nodes over the SAME op registry as
+``mx.nd`` -- execution is a topological walk of pure JAX calls, jitted by
+the Executor (the XLA answer to GraphExecutor+PlanMemory: buffer
+assignment and fusion come from the compiler).
+
+Serialization keeps the reference's ``-symbol.json`` schema (``nodes`` /
+``arg_nodes`` / ``heads``) so exported models interoperate.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError, _NameManager
+from ..ops.registry import OP_REGISTRY, get_op
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "_eval_symbol"]
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op, name, attrs, inputs, num_outputs=1):
+        self.op = op            # op name string, or None for variable
+        self.name = name
+        self.attrs = attrs      # dict[str, str-able]
+        self.inputs = inputs    # list[(Node, out_index)]
+        self.num_outputs = num_outputs
+
+
+class Symbol:
+    """One or more output entries of a graph (reference: ``Symbol``)."""
+
+    def __init__(self, outputs):
+        self._outputs = outputs  # list[(Node, out_index)]
+
+    # -- composition ---------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group[%d]" % len(self._outputs))
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %r not found" % index)
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    def _binop(self, other, opname, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _make_node(opname, [lhs, rhs], {})
+        scalar_map = {"elemwise_add": "_plus_scalar",
+                      "elemwise_sub": "_rminus_scalar" if reverse else "_minus_scalar",
+                      "elemwise_mul": "_mul_scalar",
+                      "elemwise_div": "_rdiv_scalar" if reverse else "_div_scalar",
+                      "broadcast_power": "_rpower_scalar" if reverse else "_power_scalar"}
+        return _make_node(scalar_map[opname], [self], {"scalar": float(other)})
+
+    def __add__(self, o):
+        return self._binop(o, "elemwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "elemwise_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "elemwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "elemwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "elemwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "elemwise_div", reverse=True)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    def __neg__(self):
+        return _make_node("negative", [self], {})
+
+    # -- graph queries -------------------------------------------------
+    def _topo(self):
+        order = []
+        seen = set()
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def list_arguments(self):
+        """Variable names in topo order (reference: ``list_arguments``)."""
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self):
+        out = []
+        for node, idx in self._outputs:
+            if node.num_outputs > 1:
+                out.append("%s_output%d" % (node.name, idx))
+            else:
+                out.append(node.name + "_output")
+        return out
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        nodes = self._topo()
+        return Symbol([(n, i) for n in nodes for i in range(n.num_outputs)])
+
+    def attr(self, key):
+        return self._outputs[0][0].attrs.get(key)
+
+    # -- shape/type inference -----------------------------------------
+    def infer_shape(self, **kwargs):
+        """Reference: ``infer_shape`` (nnvm InferShape pass) -- here via
+        jax.eval_shape over the graph."""
+        import jax
+        arg_names = self.list_arguments()
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        missing = [a for a in arg_names if a not in known]
+        if missing:
+            return None, None, None
+        specs = {a: jax.ShapeDtypeStruct(known[a], np.float32)
+                 for a in arg_names}
+        outs = _eval_symbol_abstract(self, specs)
+        arg_shapes = [known[a] for a in arg_names]
+        out_shapes = [tuple(o.shape) for o in outs]
+        return arg_shapes, out_shapes, []
+
+    def infer_type(self, **kwargs):
+        arg_names = self.list_arguments()
+        return ([np.float32] * len(arg_names),
+                [np.float32] * len(self._outputs), [])
+
+    # -- execution -----------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from ..ndarray import NDArray
+        feed = {k: v for k, v in kwargs.items()}
+        outs = _eval_symbol(self, feed)
+        return outs
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..executor import Executor
+        from ..ndarray import zeros
+        args = {}
+        for name in self.list_arguments():
+            if name in shapes:
+                args[name] = zeros(shapes[name], ctx=ctx)
+            else:
+                raise MXNetError("simple_bind: missing shape for %r" % name)
+        args_grad = {k: zeros(v.shape, ctx=ctx) for k, v in args.items()} \
+            if grad_req != "null" else None
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    # -- serialization (reference: nnvm saveload_json.cc) -------------
+    def tojson(self):
+        nodes = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": n.op if n.op is not None else "null",
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[node_ids[id(src)], oi, 0] for src, oi in n.inputs],
+            })
+        heads = [[node_ids[id(n)], oi, 0] for n, oi in self._outputs]
+        arg_nodes = [i for i, n in enumerate(nodes) if n.op is None]
+        return json.dumps({
+            "nodes": jnodes, "arg_nodes": arg_nodes, "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10700],
+                      "mxnet_tpu": ["str", "1"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def var(name, shape=None, dtype=None, **kwargs):
+    """Create a variable symbol (reference: ``symbol.var``)."""
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(_Node(None, name, attrs, []), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _parse_attr_value(v):
+    s = str(v)
+    try:
+        return eval(s, {"__builtins__": {}}, {})  # tuples/numbers/bools
+    except Exception:
+        return s
+
+
+def _make_node(opname, input_syms, params, name=None):
+    op = get_op(opname)
+    hint = opname.lower().lstrip("_")
+    name = _NameManager.current().get(name, hint)
+    inputs = []
+    for s in input_syms:
+        if not isinstance(s, Symbol):
+            raise MXNetError("op %s: expected Symbol input, got %r"
+                             % (opname, s))
+        if len(s._outputs) != 1:
+            raise MXNetError("op %s: cannot take group symbol" % opname)
+        inputs.append(s._outputs[0])
+    # count outputs via an abstract probe later; store param attrs now
+    node = _Node(opname, name, dict(params), inputs)
+    node.num_outputs = _probe_num_outputs(op, node)
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 else Symbol([(node, 0)])
+
+
+def _probe_num_outputs(op, node):
+    # cheap static probes for known multi-output ops
+    if op.name == "split" or op.name == "SliceChannel":
+        return int(node.attrs.get("num_outputs", 1))
+    if op.name == "BatchNorm":
+        return 3
+    if op.name == "RNN":
+        return 3 if node.attrs.get("mode", "lstm") == "lstm" else 2
+    if op.name == "topk":
+        return 2 if node.attrs.get("ret_typ") == "both" else 1
+    return 1
+
+
+def _eval_node_value(node, values, op_params_override=None):
+    """Evaluate one node given input values."""
+    from .. import random as _random_mod
+    op = get_op(node.op)
+    params = op.param_defaults()
+    for k, v in node.attrs.items():
+        if k.startswith("__"):
+            continue
+        if any(p.name == k for p in op.params):
+            params[k] = _parse_attr_value(v)
+    args = [values[(id(src), oi)] for src, oi in node.inputs]
+    if not op.variadic and len(args) < len(op.arg_names):
+        # optional trailing tensor inputs (e.g. bias with no_bias=True)
+        args = args + [None] * (len(op.arg_names) - len(args))
+    fn = op.fcompute
+    if op.stateful_rng:
+        import functools
+        fn = functools.partial(fn, _random_mod.next_key())
+    from .. import autograd
+    if any(p.name == "training" for p in op.params) and \
+            "training" not in node.attrs:
+        params["training"] = autograd.is_training()
+    return fn(*args, **params)
+
+
+def _eval_symbol(sym, feed):
+    """Execute a symbol graph eagerly against a name->NDArray feed."""
+    from ..ndarray import NDArray
+    values = {}
+    for node in sym._topo():
+        if node.op is None:
+            if node.name not in feed:
+                raise MXNetError("missing input %r" % node.name)
+            v = feed[node.name]
+            values[(id(node), 0)] = getattr(v, "_data", v)
+        else:
+            out = _eval_node_value(node, values)
+            if isinstance(out, (tuple, list)):
+                for i, o in enumerate(out):
+                    values[(id(node), i)] = o
+            else:
+                values[(id(node), 0)] = out
+    return [NDArray(values[(id(n), oi)]) for n, oi in sym._outputs]
+
+
+def _eval_symbol_abstract(sym, specs):
+    import jax
+
+    names = sym.list_arguments()
+
+    def fn(vals):
+        feed = {n: _FakeND(vals[n]) for n in names}
+        outs = _eval_symbol(sym, feed)
+        return [o._data for o in outs]
+
+    class _FakeND:
+        def __init__(self, data):
+            self._data = data
+
+    return jax.eval_shape(fn, {n: specs[n] for n in names})
+
+
+def load_json(json_str):
+    """Parse a ``-symbol.json`` graph (reference: ``sym.load_json``)."""
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    nodes = []
+    for jn in jnodes:
+        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], attrs, [])
+        else:
+            opname = jn["op"]
+            if opname not in OP_REGISTRY:
+                raise MXNetError("symbol json references unknown op %r"
+                                 % opname)
+            node = _Node(opname, jn["name"], attrs, [])
+        nodes.append(node)
+    for jn, node in zip(jnodes, nodes):
+        node.inputs = [(nodes[i], oi) for i, oi, *_ in jn["inputs"]]
+        if node.op is not None:
+            node.num_outputs = _probe_num_outputs(get_op(node.op), node)
+    heads = data.get("heads", [[len(nodes) - 1, 0, 0]])
+    return Symbol([(nodes[i], oi) for i, oi, *_ in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,158 @@
+"""gluon.data (reference: ``tests/python/unittest/test_gluon_data.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import ArrayDataset, BatchSampler, DataLoader
+from mxnet_tpu.gluon.data import RandomSampler, SequentialSampler
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_array_dataset():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    ds = ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    assert (x0 == X[3]).all() and y0 == 3
+
+
+def test_dataset_transform():
+    ds = ArrayDataset(np.arange(5, dtype=np.float32))
+    t = ds.transform(lambda x: x * 2)
+    assert t[2] == 4
+    ds2 = ArrayDataset(np.arange(4, dtype=np.float32),
+                       np.arange(4, dtype=np.float32))
+    tf = ds2.transform_first(lambda x: x + 100)
+    x, y = tf[1]
+    assert x == 101 and y == 1
+
+
+def test_samplers():
+    assert list(SequentialSampler(4)) == [0, 1, 2, 3]
+    assert sorted(RandomSampler(5)) == list(range(5))
+    bs = BatchSampler(SequentialSampler(5), 2, "keep")
+    assert list(bs) == [[0, 1], [2, 3], [4]]
+    bs2 = BatchSampler(SequentialSampler(5), 2, "discard")
+    assert list(bs2) == [[0, 1], [2, 3]]
+
+
+def test_dataloader_basic():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    assert yb.asnumpy().tolist() == [0, 1, 2, 3]
+
+
+def test_dataloader_shuffle_lastbatch():
+    ds = ArrayDataset(np.arange(10, dtype=np.float32))
+    loader = DataLoader(ds, batch_size=3, shuffle=True, last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.concatenate([b.asnumpy() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_dataloader_workers():
+    X = np.random.rand(20, 3).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X), batch_size=5, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    got = np.concatenate([b.asnumpy() for b in batches])
+    np.testing.assert_allclose(got, X)  # order preserved
+
+
+def test_transforms():
+    img = (np.random.rand(8, 6, 3) * 255).astype(np.uint8)
+    t = transforms.ToTensor()(mx.nd.array(img, dtype="uint8"))
+    assert t.shape == (3, 8, 6)
+    assert t.asnumpy().max() <= 1.0
+    n = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.5, 0.5, 0.5))(t)
+    assert n.asnumpy().min() >= -1.001
+    r = transforms.Resize(4)(mx.nd.array(img, dtype="uint8"))
+    assert r.shape == (4, 4, 3)
+    c = transforms.CenterCrop(4)(mx.nd.array(img, dtype="uint8"))
+    assert c.shape == (4, 4, 3)
+    rc = transforms.RandomResizedCrop(5)(mx.nd.array(img, dtype="uint8"))
+    assert rc.shape == (5, 5, 3)
+    comp = transforms.Compose([transforms.Resize(4), transforms.ToTensor()])
+    assert comp(mx.nd.array(img, dtype="uint8")).shape == (3, 4, 4)
+
+
+def test_mnist_synthetic_fallback():
+    ds = gluon.data.vision.MNIST(root="/nonexistent-path", train=False)
+    assert ds.synthetic
+    assert len(ds) == 10000
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) < 10
+
+
+def test_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(5):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, b"payload%d" % i))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    assert r.keys == [0, 1, 2, 3, 4]
+    h, payload = recordio.unpack(r.read_idx(3))
+    assert h.label == 3.0
+    assert payload == b"payload3"
+
+
+def test_recordio_image_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    img = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+    packed = recordio.pack_img(recordio.IRHeader(0, 7.0, 0, 0), img,
+                               img_fmt=".png")
+    header, decoded = recordio.unpack_img(packed)
+    assert header.label == 7.0
+    np.testing.assert_array_equal(decoded, img)  # png is lossless
+
+
+def test_image_record_dataset(tmp_path):
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(4):
+        img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i, 0), img, img_fmt=".png"))
+    w.close()
+    ds = gluon.data.vision.ImageRecordDataset(rec_path)
+    assert len(ds) == 4
+    img, label = ds[1]
+    assert img.shape == (8, 8, 3)
+    assert label == 1.0
+
+
+def test_ndarray_iter():
+    from mxnet_tpu.io import NDArrayIter
+    X = np.random.rand(10, 4).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(X, y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter():
+    from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+    X = np.random.rand(8, 2).astype(np.float32)
+    it = PrefetchingIter(NDArrayIter(X, np.zeros(8), batch_size=4))
+    batches = list(it)
+    assert len(batches) == 2

@@ -1,0 +1,74 @@
+"""Metrics (reference: metric tests inside ``test_metric.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update(label, pred)
+    assert m.get() == ("accuracy", 2 / 3)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.6, 0.3, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update(label, pred)
+    assert m.get()[1] == 1.0
+
+
+def test_mse_mae():
+    mse = metric.MSE()
+    mse.update(mx.nd.array([1.0, 2.0]), mx.nd.array([0.0, 0.0]))
+    assert abs(mse.get()[1] - 2.5) < 1e-6
+    mae = metric.MAE()
+    mae.update(mx.nd.array([1.0, -3.0]), mx.nd.array([0.0, 0.0]))
+    assert abs(mae.get()[1] - 2.0) < 1e-6
+
+
+def test_crossentropy_perplexity():
+    ce = metric.create("ce")
+    prob = mx.nd.array([[0.2, 0.8], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    ce.update(label, prob)
+    expect = -(np.log(0.8) + np.log(0.9)) / 2
+    assert abs(ce.get()[1] - expect) < 1e-5
+    p = metric.Perplexity()
+    p.update(label, prob)
+    assert abs(p.get()[1] - np.exp(expect)) < 1e-4
+
+
+def test_f1():
+    f1 = metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 1, 0])
+    f1.update(label, pred)
+    # tp=1 fp=1 fn=1 -> p=r=0.5 -> f1=0.5
+    assert abs(f1.get()[1] - 0.5) < 1e-6
+
+
+def test_composite_and_create():
+    c = metric.create(["accuracy", metric.TopKAccuracy(top_k=2)])
+    pred = mx.nd.array([[0.1, 0.9, 0.0]])
+    c.update(mx.nd.array([1]), pred)
+    names, values = c.get()
+    assert "accuracy" in names[0]
+    assert values[0] == 1.0 and values[1] == 1.0
+
+
+def test_custom_metric():
+    m = metric.CustomMetric(lambda l, p: float((l == p.argmax(-1)).mean()))
+    m.update(mx.nd.array([1]), mx.nd.array([[0.0, 1.0]]))
+    assert m.get()[1] == 1.0
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, mx.nd.array([2.0, 4.0]))
+    assert m.get()[1] == 3.0

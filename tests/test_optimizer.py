@@ -1,0 +1,135 @@
+"""Optimizers/schedulers (reference: ``tests/python/unittest/test_optimizer.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(optimizer, w0, grads):
+    w = mx.nd.array(w0)
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.array([1.0, -2.0], np.float32)
+    g = np.array([0.5, 0.5], np.float32)
+    o = opt.create("sgd", learning_rate=0.1, wd=0.0)
+    got = _run_steps(o, w0, [g, g])
+    assert_almost_equal(got, w0 - 0.1 * g * 2, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    w = np.array([1.0], np.float32)
+    g = np.array([1.0], np.float32)
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    got = _run_steps(o, w, [g, g])
+    # manual: m1=-0.1, w1=0.9; m2=0.9*-0.1-0.1=-0.19, w2=0.71
+    assert_almost_equal(got, [0.71], rtol=1e-5)
+
+
+def test_sgd_wd():
+    w = np.array([1.0], np.float32)
+    g = np.array([0.0], np.float32)
+    o = opt.create("sgd", learning_rate=0.1, wd=0.1)
+    got = _run_steps(o, w, [g])
+    assert_almost_equal(got, [1.0 - 0.1 * 0.1], rtol=1e-5)
+
+
+def test_adam_first_step():
+    w = np.array([1.0], np.float32)
+    g = np.array([0.5], np.float32)
+    o = opt.create("adam", learning_rate=0.1)
+    got = _run_steps(o, w, [g])
+    # bias-corrected first step ~ lr * sign(g)
+    assert abs(got[0] - (1.0 - 0.1)) < 1e-2
+
+
+def test_rmsprop_runs():
+    o = opt.create("rmsprop", learning_rate=0.01)
+    got = _run_steps(o, np.ones(3, np.float32), [np.ones(3, np.float32)] * 3)
+    assert (got < 1).all()
+
+
+def test_adagrad_ftrl_signum_nag():
+    for name in ("adagrad", "ftrl", "signum", "nag"):
+        o = opt.create(name)
+        got = _run_steps(o, np.ones(2, np.float32),
+                         [np.full(2, 0.5, np.float32)] * 2)
+        assert got.shape == (2,)
+
+
+def test_lamb_trust_ratio():
+    o = opt.create("lamb", learning_rate=0.01)
+    w = np.full(4, 2.0, np.float32)
+    got = _run_steps(o, w, [np.full(4, 0.1, np.float32)])
+    assert (got < 2.0).all()
+
+
+def test_lars_runs():
+    o = opt.create("lars", learning_rate=0.1, momentum=0.9)
+    got = _run_steps(o, np.ones(4, np.float32),
+                     [np.full(4, 0.5, np.float32)] * 2)
+    assert (got < 1.0).all()
+
+
+def test_clip_gradient():
+    o = opt.create("sgd", learning_rate=1.0, clip_gradient=0.1)
+    got = _run_steps(o, np.zeros(1, np.float32), [np.array([10.0], np.float32)])
+    assert_almost_equal(got, [-0.1], rtol=1e-5)
+
+
+def test_rescale_grad():
+    o = opt.create("sgd", learning_rate=1.0, rescale_grad=0.5)
+    got = _run_steps(o, np.zeros(1, np.float32), [np.array([1.0], np.float32)])
+    assert_almost_equal(got, [-0.5], rtol=1e-5)
+
+
+def test_updater_state_roundtrip():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = mx.nd.ones((3,))
+    u(0, mx.nd.ones((3,)), w)
+    blob = u.get_states()
+    u2 = opt.get_updater(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    u2.set_states(blob)
+    assert 0 in u2.states
+
+
+def test_lr_schedulers():
+    s = opt.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    ms = opt.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert ms(1) == 1.0
+    assert abs(ms(6) - 0.1) < 1e-9
+    assert abs(ms(11) - 0.01) < 1e-9
+    ps = opt.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(ps(50) - 0.5) < 1e-6
+    cs = opt.CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(cs(50) - 0.5) < 1e-6
+    assert cs(100) < 1e-6
+
+
+def test_warmup():
+    s = opt.PolyScheduler(max_update=100, base_lr=1.0, warmup_steps=10,
+                          warmup_begin_lr=0.0)
+    assert s(0) == 0.0
+    assert abs(s(5) - 0.5) < 1e-6
+
+
+def test_optimizer_with_scheduler():
+    sched = opt.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    o = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.zeros((1,))
+    st = o.create_state(0, w)
+    o.update(0, w, mx.nd.ones((1,)), st)
+    lr1 = o.learning_rate
+    for _ in range(5):
+        o.update(0, w, mx.nd.ones((1,)), st)
+    assert o.learning_rate < lr1
