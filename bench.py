@@ -1,0 +1,133 @@
+"""Benchmark harness (driver contract + BASELINE.md configs).
+
+Measures steady-state training throughput on the available accelerator
+(the one real TPU chip under the driver; CPU otherwise):
+
+- config 1: LeNet-style convnet, MNIST shapes, hybridized Gluon
+- config 2: ResNet-50 v1, synthetic ImageNet batches (the headline)
+
+Each config times the FULL training step (forward + loss + backward +
+optimizer update) as one compiled program (``mxnet_tpu.parallel.TrainStep``)
+with device-resident synthetic data, after warmup.  Reference analog:
+``example/image-classification/common/fit.py :: Speedometer`` samples/sec.
+
+Prints one progress JSON object per config, then the final parseable line:
+``{"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}``.
+vs_baseline denominator: BASELINE.md's A100 anchor for MXNet-CUDA
+ResNet-50 (~3000 img/s with DALI+AMP; unverified memory anchor).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def _ctx():
+    import mxnet_tpu as mx
+    return mx.tpu() if mx.num_tpus() else mx.cpu()
+
+
+def _bench_train(net, loss_fn, data_shape, label_shape, n_classes,
+                 batch_size, lr=0.05, warmup=5, iters=30, dtype="float32"):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import TrainStep
+
+    ctx = _ctx()
+    net.initialize(ctx=ctx, force_reinit=True)
+    if dtype != "float32":
+        net.cast(dtype)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9},
+                            kvstore=None)
+    step = TrainStep(net, loss_fn, trainer, mesh=None)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(*data_shape).astype(np.float32), ctx=ctx)
+    if dtype != "float32":
+        x = x.astype(dtype)
+    y = mx.nd.array(
+        rng.randint(0, n_classes, size=label_shape).astype(np.float32),
+        ctx=ctx)
+    for _ in range(warmup):
+        step(x, y)
+    # Synchronize via a scalar host fetch: on the axon tunnel
+    # block_until_ready can return before execution finishes, so a value
+    # dependency is the only trustworthy barrier.  Steps are chained
+    # through the parameters, so fetching the last loss drains the queue.
+    float(step(x, y).asscalar())
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(iters):
+        last = step(x, y)
+    float(last.asscalar())
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def bench_lenet(batch_size=256):
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Conv2D(50, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(500, activation="relu"),
+            gluon.nn.Dense(10))
+    return _bench_train(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        (batch_size, 1, 28, 28), (batch_size,), 10,
+                        batch_size, warmup=5, iters=50)
+
+
+def bench_resnet50(batch_size=128, dtype="float32"):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    net = resnet50_v1()
+    return _bench_train(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        (batch_size, 3, 224, 224), (batch_size,), 1000,
+                        batch_size, warmup=5, iters=20, dtype=dtype)
+
+
+def main():
+    import mxnet_tpu as mx
+    results = {}
+    on_tpu = mx.num_tpus() > 0
+    # CPU fallback keeps the harness runnable in dev; shrink the work.
+    if on_tpu:
+        lenet_bs, rn_bs, = 256, 128
+    else:
+        lenet_bs, rn_bs = 64, 8
+
+    lenet = bench_lenet(lenet_bs)
+    results["lenet_mnist_train"] = lenet
+    print(json.dumps({"metric": "lenet_mnist_train", "value": round(lenet, 1),
+                      "unit": "img/s", "vs_baseline": None}))
+
+    rn = bench_resnet50(rn_bs)
+    results["resnet50_train_fp32"] = rn
+    print(json.dumps({"metric": "resnet50_imagenet_train_fp32",
+                      "value": round(rn, 1), "unit": "img/s",
+                      "vs_baseline": None}))
+
+    headline = rn
+    try:
+        rn_bf16 = bench_resnet50(rn_bs, dtype="bfloat16")
+        results["resnet50_train_bf16"] = rn_bf16
+        print(json.dumps({"metric": "resnet50_imagenet_train_bf16",
+                          "value": round(rn_bf16, 1), "unit": "img/s",
+                          "vs_baseline": None}))
+        headline = max(headline, rn_bf16)
+    except Exception as e:  # bf16 path optional until AMP lands fully
+        print(json.dumps({"metric": "resnet50_imagenet_train_bf16",
+                          "error": str(e)[:200]}))
+
+    # BASELINE.md anchor: MXNet-CUDA A100 ResNet-50 ~3000 img/s (AMP+DALI)
+    baseline = 3000.0
+    print(json.dumps({"metric": "resnet50_imagenet_train",
+                      "value": round(headline, 1), "unit": "img/s",
+                      "vs_baseline": round(headline / baseline, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,7 @@
 Brand-new design (not a port) of the reference ``MXNetEdge/incubator-mxnet``
 per ``SURVEY.md``: imperative NDArray + per-op autograd, Gluon blocks with
 ``hybridize()`` -> XLA jit, KVStore over ICI/DCN collectives, RecordIO data
-pipeline, AMP, Pallas fused kernels.  Compute substrate: JAX/XLA/PJRT.
+pipeline.  Compute substrate: JAX/XLA/PJRT.
 
 Typical use mirrors the reference::
 
@@ -56,4 +56,5 @@ from . import kvstore as kv
 from . import recordio
 from . import io
 from . import image
+from . import parallel
 from . import test_utils

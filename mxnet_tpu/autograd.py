@@ -114,20 +114,32 @@ def mark_variables(variables, gradients, grad_reqs="write"):
 
 
 def _toposort(head_arrays):
-    """Reverse-topological order of tape nodes reachable from heads."""
+    """Reverse-topological order of tape nodes reachable from heads.
+
+    Iterative DFS: tape length is unbounded (e.g. a long imperative RNN
+    unroll records thousands of sequential ops), so recursion would hit
+    the Python stack limit.
+    """
     order = []
     seen = set()
-
-    def visit(node):
-        if node is None or id(node) in seen:
-            return
-        seen.add(id(node))
-        for inp in node.inputs:
-            visit(getattr(inp, "_ag_node", None))
-        order.append(node)
-
     for arr in head_arrays:
-        visit(getattr(arr, "_ag_node", None))
+        root = getattr(arr, "_ag_node", None)
+        if root is None or id(root) in seen:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, expanded = stack.pop()
+            if expanded:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for inp in reversed(node.inputs):
+                src = getattr(inp, "_ag_node", None)
+                if src is not None and id(src) not in seen:
+                    stack.append((src, False))
     return order[::-1]
 
 

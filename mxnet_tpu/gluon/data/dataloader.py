@@ -13,9 +13,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
+from ...base import MXNetError
 from ...ndarray import NDArray, array
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
@@ -77,16 +79,26 @@ class DataLoader:
         results = {}
         results_lock = threading.Lock()
         results_ready = threading.Condition(results_lock)
+        # Prefetch bound: decoded-but-unconsumed batches never exceed this,
+        # so memory stays O(prefetch), not O(dataset).
+        prefetch = max(self._prefetch, 1)
         work = queue.Queue()
         for i, b in enumerate(batches):
             work.put((i, b))
         stop = threading.Event()
+        next_wanted = [0]
 
         def worker():
             while not stop.is_set():
                 try:
                     i, indices = work.get_nowait()
                 except queue.Empty:
+                    return
+                with results_ready:
+                    while (not stop.is_set()
+                           and i >= next_wanted[0] + prefetch):
+                        results_ready.wait(0.1)
+                if stop.is_set():
                     return
                 try:
                     out = self._make_batch(indices)
@@ -102,12 +114,26 @@ class DataLoader:
             t.start()
         try:
             for i in range(len(batches)):
+                deadline = (time.monotonic() + self._timeout
+                            if self._timeout else None)
                 with results_ready:
+                    next_wanted[0] = i
+                    results_ready.notify_all()
                     while i not in results:
-                        results_ready.wait(self._timeout)
+                        remaining = (deadline - time.monotonic()
+                                     if deadline else None)
+                        if remaining is not None and remaining <= 0:
+                            raise MXNetError(
+                                "DataLoader worker timed out after %ss "
+                                "waiting for batch %d" % (self._timeout, i))
+                        results_ready.wait(remaining if remaining is not None
+                                           else 1.0)
                     out = results.pop(i)
+                    results_ready.notify_all()
                 if isinstance(out, Exception):
                     raise out
                 yield out
         finally:
             stop.set()
+            with results_ready:
+                results_ready.notify_all()

@@ -232,6 +232,12 @@ class CTCLoss(Loss):
         same_as_prev2 = jnp.concatenate(
             [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
 
+        if pred_lengths is not None:
+            pl = (pred_lengths._data if isinstance(pred_lengths, NDArray)
+                  else pred_lengths).astype(jnp.int32)
+        else:
+            pl = jnp.full((B,), T, jnp.int32)
+
         def step(alpha, t):
             a_shift1 = jnp.concatenate(
                 [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
@@ -246,7 +252,10 @@ class CTCLoss(Loss):
             newa = m_safe + jnp.log(jnp.maximum(summed, 1e-37))
             newa = jnp.where(m <= neg_inf / 2, neg_inf, newa)
             emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
-            return newa + emit, None
+            # Padded timesteps (t >= pred_length) carry alpha unchanged so
+            # the final read-off sees each sample's own last valid step.
+            active = (t < pl)[:, None]
+            return jnp.where(active, newa + emit, alpha), None
 
         alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
         if label_lengths is not None:

@@ -107,6 +107,11 @@ class Parameter:
         if not isinstance(ini, initializer.Initializer):
             ini = initializer.create(ini)
         ini(initializer.InitDesc(self.name), data)
+        if self._sharding is not None:
+            # deferred-init param of a mesh-replicated block: place the
+            # fresh array with the recorded sharding (parallel.replicate_block)
+            import jax
+            data._data = jax.device_put(data._data, self._sharding)
         self._data = data
         self._deferred_init = None
         if self._grad_req != "null":

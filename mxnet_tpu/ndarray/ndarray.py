@@ -47,16 +47,21 @@ _FLAG_TO_MX_DTYPE[100] = np.dtype(jnp.bfloat16.dtype)
 
 def waitall():
     """Block until all async work completes (reference:
-    ``mx.nd.waitall`` / ``Engine::WaitForAll``)."""
-    try:
-        (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
-    except Exception:
-        pass
+    ``mx.nd.waitall`` / ``Engine::WaitForAll``).
+
+    Device-side errors raised by in-flight computations surface HERE, at
+    the sync point -- the reference's contract (``threaded_engine.cc ::
+    OnCompleteStatic`` re-throws captured exceptions at WaitForAll /
+    WaitToRead).  Errors from deleted arrays whose computations already
+    failed cannot be resurrected, but every live array's pending work is
+    drained and the first failure propagates.
+    """
+    if hasattr(jax, "effects_barrier"):
+        jax.effects_barrier()
     for d in jax.live_arrays():
-        try:
-            d.block_until_ready()
-        except Exception:
-            pass
+        if isinstance(d, jax.core.Tracer):
+            continue
+        d.block_until_ready()
 
 
 def _is_traced(x):

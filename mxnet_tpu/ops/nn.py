@@ -224,8 +224,7 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     """Layer normalization (reference: ``src/operator/nn/layer_norm.cc``).
 
-    Written so XLA fuses the whole thing into one pass; the Pallas variant
-    (``ops/pallas/layernorm.py``) is used by AMP/large-model paths.
+    Written so XLA fuses the whole thing into one elementwise pass.
     """
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(data - mean), axis=axis, keepdims=True)

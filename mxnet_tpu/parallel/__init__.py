@@ -1,0 +1,14 @@
+"""Parallelism: device meshes, data-parallel sharding, compiled train steps.
+
+TPU-native replacement for the reference's multi-device stack (SURVEY.md
+§2.4): ``jax.sharding.Mesh`` + SPMD partitioning replace per-device
+parameter copies, CommDevice reduction, and NCCL.
+"""
+from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
+                   local_devices, make_mesh)
+from .data_parallel import (TrainStep, replicate_block, shard_batch,
+                            split_and_load)
+
+__all__ = ["Mesh", "NamedSharding", "PartitionSpec", "default_mesh",
+           "local_devices", "make_mesh", "TrainStep", "replicate_block",
+           "shard_batch", "split_and_load"]

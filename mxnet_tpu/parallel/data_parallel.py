@@ -1,0 +1,369 @@
+"""Data parallelism over a ``jax.sharding.Mesh``.
+
+TPU-native re-design of the reference's data-parallel stack
+(``src/kvstore/comm.h :: CommDevice`` in-process reduce,
+``python/mxnet/module/executor_group.py :: DataParallelExecutorGroup``
+batch slicing, NCCL allreduce):
+
+- The reference keeps one parameter/gradient copy per GPU and reduces
+  between them.  Here there is ONE logical ``jax.Array`` per tensor:
+  parameters are *replicated* over the mesh, the batch is *sharded* over
+  the ``dp`` axis, and XLA's SPMD partitioner inserts the gradient
+  ``psum`` over ICI inside the compiled step -- the comm/compute overlap
+  the reference gets from engine-ordered NCCL calls falls out of XLA's
+  latency-hiding scheduler.
+- ``TrainStep`` compiles forward + loss + backward + optimizer update
+  into ONE donated-buffer XLA program: the answer to the reference's
+  bulked CachedOp forward/backward plus fused ``multi_sgd_update``
+  (``src/operator/optimizer_op.cc``) in a single dispatch.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import random as _random_mod
+
+__all__ = ["replicate_block", "shard_batch", "split_and_load", "TrainStep"]
+
+
+def _replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def _batch_sharding(mesh, ndim, batch_axis=0, axis_name="dp"):
+    spec = [None] * ndim
+    spec[batch_axis] = axis_name
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate_block(block_or_params, mesh):
+    """Place every initialized parameter (and its grad buffer) replicated
+    over the mesh.  The reference analog is ``ParameterDict.reset_ctx`` to
+    a list of contexts; one replicated jax.Array replaces the per-device
+    copy list."""
+    params = block_or_params
+    if hasattr(params, "collect_params"):
+        params = params.collect_params()
+    sh = _replicated(mesh)
+    for p in params.values():
+        p._sharding = sh  # consumed by Parameter._finish_init for deferred
+        if p._data is not None:
+            p._data._data = jax.device_put(p._data._data, sh)
+            if p._data._grad is not None:
+                p._data._grad._data = jax.device_put(p._data._grad._data, sh)
+    return block_or_params
+
+
+def shard_batch(data, mesh, batch_axis=0, axis_name="dp"):
+    """Shard one batch array over the mesh's data-parallel axis.
+
+    Returns an NDArray backed by a single global jax.Array whose shards
+    live on the mesh devices (the reference's
+    ``DataParallelExecutorGroup`` batch slicing, done by sharding)."""
+    x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = mesh.shape[axis_name]
+    if x.shape[batch_axis] % n:
+        raise MXNetError(
+            "batch axis %d (size %d) not divisible by %s=%d"
+            % (batch_axis, x.shape[batch_axis], axis_name, n))
+    return NDArray(jax.device_put(
+        x, _batch_sharding(mesh, x.ndim, batch_axis, axis_name)))
+
+
+def split_and_load(data, ctx_list=None, mesh=None, batch_axis=0,
+                   even_split=True):
+    """Reference: ``gluon.utils.split_and_load`` -- slice a batch across
+    devices.  With ``mesh`` given, returns a one-element list holding a
+    single mesh-sharded NDArray (the TPU-idiomatic form); with
+    ``ctx_list``, returns per-context slices (API compatibility)."""
+    from ..ndarray import array as nd_array
+    if mesh is not None:
+        return [shard_batch(data, mesh, batch_axis)]
+    if not ctx_list:
+        raise MXNetError("split_and_load needs ctx_list or mesh")
+    if isinstance(data, NDArray):
+        data = data.asnumpy()
+    data = np.asarray(data)
+    n = len(ctx_list)
+    size = data.shape[batch_axis]
+    if even_split and size % n:
+        raise MXNetError("batch size %d not divisible by %d contexts"
+                         % (size, n))
+    step = size // n
+    slices = []
+    for i, ctx in enumerate(ctx_list):
+        lo = i * step
+        hi = (i + 1) * step if i < n - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(nd_array(data[tuple(idx)], ctx=ctx))
+    return slices
+
+
+# ----------------------------------------------------------------------
+# Functional optimizer update (traced)
+# ----------------------------------------------------------------------
+
+class _TracedCount(dict):
+    """Stands in for ``Optimizer._index_update_count`` during tracing so
+    the per-step counter ``t`` is a traced input, not a baked constant."""
+
+    def __init__(self, t):
+        super().__init__()
+        self._t = t
+
+    def __getitem__(self, k):
+        return self._t
+
+    def __contains__(self, k):
+        return True
+
+
+@contextlib.contextmanager
+def _scalar_feed(opt, t, lr_by_idx, wd_by_idx, rescale):
+    """Route every host-side scalar the optimizer reads (step count,
+    scheduled lr, wd, rescale_grad) to traced inputs, so one compiled
+    step stays valid across steps and lr schedules."""
+    orig = (opt._update_count, opt._get_lr, opt._get_wd,
+            opt._index_update_count, opt.rescale_grad)
+    opt._update_count = lambda index: None
+    opt._index_update_count = _TracedCount(t)
+    opt._get_lr = lambda index: lr_by_idx[index]
+    opt._get_wd = lambda index: wd_by_idx[index]
+    opt.rescale_grad = rescale
+    try:
+        yield
+    finally:
+        (opt._update_count, opt._get_lr, opt._get_wd,
+         opt._index_update_count, opt.rescale_grad) = orig
+
+
+def _wrap_state(s):
+    if s is None:
+        return None
+    if isinstance(s, (tuple, list)):
+        return tuple(_wrap_state(x) for x in s)
+    if isinstance(s, NDArray):
+        return NDArray(s._data)
+    # raw jax array / tracer leaf (inside jit): wrap so the optimizer's
+    # NDArray-rebinding update code works unchanged under trace
+    return NDArray(s)
+
+
+def _state_leaves(s):
+    if s is None:
+        return []
+    if isinstance(s, (tuple, list)):
+        out = []
+        for x in s:
+            out.extend(_state_leaves(x))
+        return out
+    if isinstance(s, NDArray):
+        return [s]
+    return []
+
+
+class TrainStep:
+    """One fully-compiled SPMD training step.
+
+    ``step = TrainStep(net, loss_fn, trainer, mesh)`` then
+    ``loss = step(data, label)``: forward, loss, backward, and the
+    optimizer update for every parameter run as a single XLA program with
+    parameter/state buffers donated.  With a mesh, the batch is sharded
+    over ``dp`` and gradients come out replicated via an XLA-inserted
+    ``psum`` over ICI.
+
+    Uses the Trainer's own optimizer and updater state, so
+    ``trainer.save_states()`` / lr schedules keep working, and
+    interleaves with eager ``trainer.step()`` if needed.
+    """
+
+    def __init__(self, block, loss_fn, trainer, mesh=None, batch_axis=0,
+                 axis_name="dp", donate=True):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._mesh = mesh
+        self._batch_axis = batch_axis
+        self._axis_name = axis_name
+        self._donate = donate
+        self._cache = {}
+        if mesh is not None:
+            replicate_block(block, mesh)
+
+    # -- state plumbing ------------------------------------------------
+    def _ensure_states(self):
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        upd = tr._updater
+        opt = tr._optimizer
+        for i, p in enumerate(tr._params):
+            if p.grad_req == "null" or p._data is None:
+                continue
+            if i not in upd.states:
+                upd.states[i] = opt.create_state_multi_precision(i, p.data())
+        if self._mesh is not None:
+            sh = _replicated(self._mesh)
+            for s in upd.states.values():
+                for leaf in _state_leaves(s):
+                    if not leaf._data.sharding.is_equivalent_to(sh, leaf._data.ndim):
+                        leaf._data = jax.device_put(leaf._data, sh)
+
+    def _diff_indices(self):
+        tr = self._trainer
+        return [i for i, p in enumerate(tr._params)
+                if p.grad_req != "null" and p._data is not None]
+
+    # -- compilation ---------------------------------------------------
+    def _build(self, ivals, training):
+        tr = self._trainer
+        opt = tr._optimizer
+        block = self._block
+        loss_fn = self._loss_fn
+        idxs = self._diff_indices()
+        pure_fn, pnames, pmap = block.functionalize(training=training)
+        name_by_idx = {i: tr._params[i].name for i in idxs}
+        def step_fn(pvals, svals, data, label, rng, t, lrs, wds, rescale):
+            def loss_of(diff_pvals):
+                merged = dict(pvals)
+                merged.update(diff_pvals)
+                outs, aux = pure_fn(merged, [data], rng)
+                out_nd = [NDArray(o) for o in outs]
+                l = loss_fn(out_nd[0] if len(out_nd) == 1 else out_nd,
+                            NDArray(label))
+                ldata = l._data if isinstance(l, NDArray) else l
+                # Sum (not mean): the reference seeds backward with ones
+                # over the batch loss and rescales by 1/batch_size in the
+                # optimizer (Trainer.step semantics).
+                return jnp.sum(ldata), (jnp.mean(ldata), aux)
+
+            diff_pvals = {name_by_idx[i]: pvals[name_by_idx[i]] for i in idxs}
+            grads_and_aux = jax.value_and_grad(loss_of, has_aux=True)(
+                diff_pvals)
+            (_, (mean_loss, aux)), grads = grads_and_aux
+
+            lr_map = {i: lrs[k] for k, i in enumerate(idxs)}
+            wd_map = {i: wds[k] for k, i in enumerate(idxs)}
+            # Start from the full pvals: every parameter buffer is donated,
+            # so every one must come back out (unchanged ones alias
+            # through), or frozen params would be left deleted.
+            new_w = dict(pvals)
+            new_s = {}
+            with _scalar_feed(opt, t, lr_map, wd_map, rescale):
+                for i in idxs:
+                    nm = name_by_idx[i]
+                    w = NDArray(pvals[nm])
+                    g = NDArray(grads[nm])
+                    s = _wrap_state(svals.get(i))
+                    opt.update_multi_precision(i, w, g, s)
+                    new_w[nm] = w._data
+                    new_s[i] = jax.tree_util.tree_map(
+                        lambda x: x._data if isinstance(x, NDArray) else x, s,
+                        is_leaf=lambda x: isinstance(x, NDArray) or x is None)
+            return new_w, new_s, aux, mean_loss
+
+        jit_kwargs = {}
+        if self._mesh is not None:
+            mesh = self._mesh
+            rep = _replicated(mesh)
+
+            def rep_tree(tree):
+                return jax.tree_util.tree_map(lambda _: rep, tree)
+
+            data_sh = _batch_sharding(mesh, len(ivals[0].shape),
+                                      self._batch_axis, self._axis_name)
+            label_sh = _batch_sharding(mesh, len(ivals[1].shape),
+                                       0, self._axis_name)
+            jit_kwargs["in_shardings"] = (
+                None, None, data_sh, label_sh, rep, rep, rep, rep, rep)
+        if self._donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+        return jax.jit(step_fn, **jit_kwargs), idxs, pnames, pmap
+
+    # -- call ----------------------------------------------------------
+    def __call__(self, data, label, batch_size=None):
+        from .. import autograd as _ag
+        tr = self._trainer
+        opt = tr._optimizer
+        self._ensure_states()
+        if not isinstance(data, NDArray):
+            data = NDArray(jnp.asarray(data))
+        if not isinstance(label, NDArray):
+            label = NDArray(jnp.asarray(label))
+        if self._mesh is not None and data._data.ndim:
+            sh = data._data.sharding
+            want = _batch_sharding(self._mesh, data._data.ndim,
+                                   self._batch_axis, self._axis_name)
+            if not sh.is_equivalent_to(want, data._data.ndim):
+                data = NDArray(jax.device_put(data._data, want))
+                lsh = _batch_sharding(self._mesh, label._data.ndim, 0,
+                                      self._axis_name)
+                label = NDArray(jax.device_put(label._data, lsh))
+        if any(p._deferred_init is not None
+               for p in self._block._all_params()):
+            # materialize deferred shapes with one eager forward;
+            # Parameter._sharding (set by replicate_block) places them
+            # replicated on the mesh
+            with _ag.pause():
+                self._block(data)
+            self._ensure_states()
+
+        training = True
+        key = (tuple(data.shape), str(data.dtype), tuple(label.shape),
+               str(label.dtype), training)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build([data, label], training)
+            self._cache[key] = entry
+        fn, idxs, pnames, pmap = entry
+
+        # host-side per-step bookkeeping (matches Optimizer._update_count)
+        for i in idxs:
+            opt._index_update_count[i] = \
+                opt._index_update_count.get(i, opt.begin_num_update) + 1
+            opt.num_update = max(opt._index_update_count[i], opt.num_update)
+        t = jnp.asarray(opt._index_update_count[idxs[0]] if idxs else
+                        opt.num_update, jnp.int32)
+        lrs = jnp.asarray([opt._get_lr(i) for i in idxs], jnp.float32)
+        wds = jnp.asarray([opt._get_wd(i) for i in idxs], jnp.float32)
+        bs = batch_size if batch_size is not None \
+            else data.shape[self._batch_axis]
+        rescale = jnp.asarray(tr._scale / bs, jnp.float32)
+
+        upd = tr._updater
+        pvals = {n: pmap[n]._data._data for n in pnames}
+        svals = {i: jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, NDArray) else x,
+            upd.states.get(i),
+            is_leaf=lambda x: isinstance(x, NDArray) or x is None)
+            for i in idxs}
+        rng = _random_mod.next_key()
+
+        new_w, new_s, aux, mean_loss = fn(pvals, svals, data._data,
+                                          label._data, rng, t, lrs, wds,
+                                          rescale)
+
+        # rebind updated weights/states/aux into the framework objects
+        # (ALL params: buffers were donated, unchanged ones aliased through)
+        for n in pnames:
+            pmap[n]._data._data = new_w[n]
+        for i in idxs:
+            s = upd.states.get(i)
+            flat_new = jax.tree_util.tree_leaves(new_s[i])
+            for leaf, nv in zip(_state_leaves(s), flat_new):
+                leaf._data = nv
+        for p in self._block._all_params():
+            if p.name in aux:
+                grad = p._data._grad if p._data is not None else None
+                p._data = NDArray(aux[p.name])
+                p._data._grad = grad
+        return NDArray(mean_loss)

@@ -9,9 +9,6 @@ dmlc-core record framing (``3rdparty/dmlc-core/include/dmlc/recordio.h``):
 cflag: 0 = whole record, 1 = first chunk, 2 = middle, 3 = last -- records
 larger than one chunk are split; magic is escaped inside payloads by
 chunking.  ``.idx`` sidecar: "key\\toffset\\n" per record.
-
-A C++ fast path (``src/recordio_native.cc``) is used for bulk reads when
-built; this module is the reference implementation and fallback.
 """
 from __future__ import annotations
 
@@ -75,17 +72,30 @@ class MXRecordIO:
     def tell(self):
         return self.record.tell()
 
-    def write(self, buf):
-        if not self.writable:
-            raise MXNetError("not opened for writing")
-        # single-chunk framing (cflag=0); large records are still one chunk
-        # since Python framing needn't split (the reader handles both)
+    _MAX_CHUNK = (1 << 29) - 1
+
+    def _write_chunk(self, cflag, buf):
         self.record.write(struct.pack("<I", kMagic))
-        self.record.write(struct.pack("<I", len(buf) & ((1 << 29) - 1)))
+        self.record.write(struct.pack("<I", (cflag << 29) | len(buf)))
         self.record.write(buf)
         pad = (4 - len(buf) % 4) % 4
         if pad:
             self.record.write(b"\x00" * pad)
+
+    def write(self, buf):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        # The length field is 29 bits; larger payloads split into
+        # cflag 1 (first) / 2 (middle) / 3 (last) chunks, matching the
+        # dmlc recordio framing, so the reader never desynchronizes.
+        if len(buf) <= self._MAX_CHUNK:
+            self._write_chunk(0, buf)
+            return
+        chunks = [buf[i:i + self._MAX_CHUNK]
+                  for i in range(0, len(buf), self._MAX_CHUNK)]
+        for i, chunk in enumerate(chunks):
+            cflag = 1 if i == 0 else (3 if i == len(chunks) - 1 else 2)
+            self._write_chunk(cflag, chunk)
 
     def read(self):
         if self.writable:
@@ -94,7 +104,12 @@ class MXRecordIO:
         while True:
             hdr = self.record.read(8)
             if len(hdr) < 8:
-                return None if not data else data
+                if data:
+                    # EOF in the middle of a multi-chunk record (chunks
+                    # seen but no cflag-3 terminator): truncated file.
+                    raise MXNetError(
+                        "corrupt recordio: EOF inside a chunked record")
+                return None
             magic, lrec = struct.unpack("<II", hdr)
             if magic != kMagic:
                 raise MXNetError("corrupt recordio: bad magic 0x%x" % magic)

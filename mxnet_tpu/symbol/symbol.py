@@ -12,6 +12,7 @@ Serialization keeps the reference's ``-symbol.json`` schema (``nodes`` /
 """
 from __future__ import annotations
 
+import ast
 import json
 
 import numpy as np
@@ -105,19 +106,26 @@ class Symbol:
 
     # -- graph queries -------------------------------------------------
     def _topo(self):
+        # Iterative DFS: graph depth is unbounded (deep sequential models),
+        # so recursion would hit the Python stack limit.
         order = []
         seen = set()
-
-        def visit(node):
-            if id(node) in seen:
-                return
-            seen.add(id(node))
-            for inp, _ in node.inputs:
-                visit(inp)
-            order.append(node)
-
-        for node, _ in self._outputs:
-            visit(node)
+        for root, _ in self._outputs:
+            if id(root) in seen:
+                continue
+            stack = [(root, False)]
+            while stack:
+                node, expanded = stack.pop()
+                if expanded:
+                    order.append(node)
+                    continue
+                if id(node) in seen:
+                    continue
+                seen.add(id(node))
+                stack.append((node, True))
+                for inp, _ in reversed(node.inputs):
+                    if id(inp) not in seen:
+                        stack.append((inp, False))
         return order
 
     def list_arguments(self):
@@ -237,10 +245,13 @@ def Group(symbols):
 
 
 def _parse_attr_value(v):
+    # Attrs loaded from -symbol.json are untrusted; literal_eval covers the
+    # tuples/numbers/bools they contain without an eval() code-exec surface
+    # (the reference parses attrs with typed dmlc parameter parsing).
     s = str(v)
     try:
-        return eval(s, {"__builtins__": {}}, {})  # tuples/numbers/bools
-    except Exception:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
         return s
 
 

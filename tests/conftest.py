@@ -1,10 +1,11 @@
 """Test harness config.
 
 Tests run on CPU with 8 virtual devices (reference test strategy SURVEY.md
-§4: cpu is the reference backend; multi-device paths are exercised the way
-the reference's nightly dist tests use local multi-process -- here via
-XLA's virtual host devices, which exercise the same Mesh/pjit sharding
-code that runs on a real v5e-8).
+§4: cpu is the reference backend).  The multi-device tests
+(tests/test_parallel.py) build a jax.sharding.Mesh over these virtual
+devices and run the same shard_map/pjit code paths that run on a real
+v5e-8, the way the reference's nightly dist tests use local
+multi-process kvstore.
 """
 import os
 
@@ -12,6 +13,7 @@ prev = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,242 @@
+"""Multi-device data parallelism over the 8 virtual CPU devices.
+
+These tests exercise the same Mesh/NamedSharding/jit code paths that run
+on a real v5e-8 (reference analog: tests/nightly dist kvstore tests run
+as local multi-process; SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import (TrainStep, make_mesh, replicate_block,
+                                shard_batch, split_and_load)
+
+
+def _cpu_devices():
+    return jax.devices("cpu")
+
+
+def _mesh(n=8):
+    return make_mesh({"dp": n}, devices=_cpu_devices()[:n])
+
+
+def _small_net(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    return net
+
+
+def test_make_mesh_sizes():
+    mesh = _mesh(8)
+    assert mesh.shape["dp"] == 8
+    mesh2 = make_mesh({"dp": -1}, devices=_cpu_devices())
+    assert mesh2.shape["dp"] == len(_cpu_devices())
+    mesh3 = make_mesh({"dp": 2, "mp": 4}, devices=_cpu_devices())
+    assert mesh3.shape == {"dp": 2, "mp": 4}
+    with pytest.raises(MXNetError):
+        make_mesh({"dp": 3, "mp": -1}, devices=_cpu_devices())
+
+
+def test_shard_batch_places_shards():
+    mesh = _mesh(8)
+    x = mx.nd.array(np.arange(64, dtype=np.float32).reshape(16, 4))
+    sx = shard_batch(x, mesh)
+    assert sx.shape == (16, 4)
+    assert len(sx._data.sharding.device_set) == 8
+    # each device holds 16/8 = 2 rows
+    shard = sx._data.addressable_shards[0]
+    assert shard.data.shape == (2, 4)
+    np.testing.assert_allclose(sx.asnumpy(), x.asnumpy())
+    with pytest.raises(MXNetError):
+        shard_batch(mx.nd.ones((10, 4)), mesh)  # 10 % 8 != 0
+
+
+def test_split_and_load_ctx_list():
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    data = np.arange(32, dtype=np.float32).reshape(8, 4)
+    parts = split_and_load(data, ctx_list=ctxs)
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 4)
+    np.testing.assert_allclose(
+        np.concatenate([p.asnumpy() for p in parts]), data)
+
+
+def test_replicated_forward_matches_single_device():
+    mesh = _mesh(8)
+    net = _small_net()
+    x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    replicate_block(net, mesh)
+    out = net(shard_batch(mx.nd.array(x), mesh))
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_sharded_backward_matches_single_device():
+    """Gradients computed from a dp-sharded batch must equal the
+    single-device gradients (XLA inserts the cross-device psum)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 4).astype(np.float32)
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(mesh):
+        net = _small_net(seed=3)
+        if mesh is not None:
+            net.hybridize()
+            replicate_block(net, mesh)
+            xs = shard_batch(mx.nd.array(x), mesh)
+            ys = shard_batch(mx.nd.array(y), mesh)
+        else:
+            xs, ys = mx.nd.array(x), mx.nd.array(y)
+        with autograd.record():
+            l = loss_fn(net(xs), ys)
+        l.backward()
+        return [p.grad().asnumpy()
+                for p in net.collect_params().values()]
+
+    g_single = run(None)
+    g_mesh = run(_mesh(8))
+    assert len(g_single) == len(g_mesh)
+    for a, b in zip(g_single, g_mesh):
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_trainstep_trains_and_stays_replicated():
+    mesh = _mesh(8)
+    net = _small_net(seed=5)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer, mesh=mesh)
+    rng = np.random.RandomState(2)
+    X = rng.randn(32, 8).astype(np.float32)
+    W = rng.randn(8, 4).astype(np.float32)
+    Y = X @ W
+    losses = []
+    for _ in range(30):
+        losses.append(float(step(mx.nd.array(X), mx.nd.array(Y)).asscalar()))
+    assert losses[-1] < losses[0] / 5, losses
+    # params must remain replicated across all 8 devices and identical
+    for p in net.collect_params().values():
+        arr = p.data()._data
+        assert len(arr.sharding.device_set) == 8
+        shards = [np.asarray(s.data) for s in arr.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
+def test_trainstep_matches_eager_trainer():
+    """One compiled TrainStep must produce the same parameters as the
+    eager record/backward/trainer.step path."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+    loss_fn = gluon.loss.L2Loss()
+
+    def eager():
+        net = _small_net(seed=11)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore=None)
+        for _ in range(3):
+            with autograd.record():
+                l = loss_fn(net(mx.nd.array(X)), mx.nd.array(Y))
+            l.backward()
+            tr.step(16)
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    def compiled():
+        net = _small_net(seed=11)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05}, kvstore=None)
+        step = TrainStep(net, loss_fn, tr, mesh=_mesh(8))
+        for _ in range(3):
+            step(mx.nd.array(X), mx.nd.array(Y))
+        return [p.data().asnumpy()
+                for p in net.collect_params().values()]
+
+    pe, pc = eager(), compiled()
+    assert len(pe) == len(pc)
+    for a, b in zip(pe, pc):
+        np.testing.assert_allclose(b, a, rtol=2e-4, atol=1e-5)
+
+
+def test_trainstep_adam_scheduler_and_states():
+    """Adam's bias correction (traced t) and an lr schedule must both take
+    effect inside the compiled step, and optimizer state must advance."""
+    mesh = _mesh(4)
+    net = _small_net(seed=13)
+    net.hybridize()
+    sched = mx.optimizer.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01, "lr_scheduler": sched},
+                            kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer, mesh=mesh)
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+    l0 = float(step(mx.nd.array(X), mx.nd.array(Y)).asscalar())
+    for _ in range(10):
+        l = float(step(mx.nd.array(X), mx.nd.array(Y)).asscalar())
+    assert l < l0
+    assert trainer._optimizer.num_update == 11
+    # momentum states must be non-zero after steps
+    st = trainer._updater.states[0]
+    assert any(np.abs(s.asnumpy()).sum() > 0
+               for s in st if s is not None)
+
+
+def test_trainstep_frozen_params_survive_donation():
+    """Frozen (grad_req='null') params must come back out of the donated
+    step buffers instead of being left deleted."""
+    mesh = _mesh(4)
+    net = _small_net(seed=17)
+    net.hybridize()
+    rng = np.random.RandomState(5)
+    X = rng.randn(8, 8).astype(np.float32)
+    Y = rng.randn(8, 4).astype(np.float32)
+    net(mx.nd.array(X))  # materialize
+    frozen = list(net.collect_params().values())[0]
+    frozen.grad_req = "null"
+    before = frozen.data().asnumpy().copy()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer, mesh=mesh)
+    for _ in range(3):
+        step(mx.nd.array(X), mx.nd.array(Y))
+    after = frozen.data().asnumpy()  # must not raise 'Array has been deleted'
+    np.testing.assert_array_equal(before, after)
+
+
+def test_trainstep_batchnorm_aux_updates():
+    """Aux state (BN running stats) must update through the compiled
+    step."""
+    mesh = _mesh(8)
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8), gluon.nn.BatchNorm(), gluon.nn.Dense(2))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer, mesh=mesh)
+    rng = np.random.RandomState(4)
+    X = rng.randn(16, 4).astype(np.float32) + 3.0
+    Y = rng.randn(16, 2).astype(np.float32)
+    step(mx.nd.array(X), mx.nd.array(Y))  # materializes deferred params
+    bn_mean = [p for p in net.collect_params().values()
+               if "running_mean" in p.name][0]
+    after1 = bn_mean.data().asnumpy().copy()
+    for _ in range(5):
+        step(mx.nd.array(X), mx.nd.array(Y))
+    after6 = bn_mean.data().asnumpy()
+    # running mean starts at zero and EMA-tracks the (shifted) batch mean
+    assert np.abs(after6).max() > np.abs(after1).max() > 0.0
