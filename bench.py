@@ -33,35 +33,38 @@ def _bench_train(net, loss_fn, data_shape, label_shape, n_classes,
     from mxnet_tpu import gluon
     from mxnet_tpu.parallel import TrainStep
 
+    import contextlib
+    from mxnet_tpu import amp
     ctx = _ctx()
     net.initialize(ctx=ctx, force_reinit=True)
-    if dtype != "float32":
-        net.cast(dtype)
     net.hybridize()
+    # mixed precision: params stay fp32, MXU ops run in the target dtype
+    amp_ctx = amp.scope(dtype) if dtype != "float32" \
+        else contextlib.nullcontext()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": lr, "momentum": 0.9},
                             kvstore=None)
     step = TrainStep(net, loss_fn, trainer, mesh=None)
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(*data_shape).astype(np.float32), ctx=ctx)
-    if dtype != "float32":
-        x = x.astype(dtype)
     y = mx.nd.array(
         rng.randint(0, n_classes, size=label_shape).astype(np.float32),
         ctx=ctx)
-    for _ in range(warmup):
-        step(x, y)
-    # Synchronize via a scalar host fetch: on the axon tunnel
-    # block_until_ready can return before execution finishes, so a value
-    # dependency is the only trustworthy barrier.  Steps are chained
-    # through the parameters, so fetching the last loss drains the queue.
-    float(step(x, y).asscalar())
-    t0 = time.perf_counter()
-    last = None
-    for _ in range(iters):
-        last = step(x, y)
-    float(last.asscalar())
-    dt = time.perf_counter() - t0
+    with amp_ctx:
+        for _ in range(warmup):
+            step(x, y)
+        # Synchronize via a scalar host fetch: on the axon tunnel
+        # block_until_ready can return before execution finishes, so a
+        # value dependency is the only trustworthy barrier.  Steps are
+        # chained through the parameters, so fetching the last loss
+        # drains the queue.
+        float(step(x, y).asscalar())
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(iters):
+            last = step(x, y)
+        float(last.asscalar())
+        dt = time.perf_counter() - t0
     return batch_size * iters / dt
 
 
@@ -112,7 +115,9 @@ def main():
 
     headline = rn
     try:
-        rn_bf16 = bench_resnet50(rn_bs, dtype="bfloat16")
+        # bf16 halves activation memory: double the batch for MXU util
+        rn_bf16 = bench_resnet50(rn_bs * 2 if on_tpu else rn_bs,
+                                 dtype="bfloat16")
         results["resnet50_train_bf16"] = rn_bf16
         print(json.dumps({"metric": "resnet50_imagenet_train_bf16",
                           "value": round(rn_bf16, 1), "unit": "img/s",

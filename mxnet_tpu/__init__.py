@@ -57,4 +57,5 @@ from . import recordio
 from . import io
 from . import image
 from . import parallel
+from . import amp
 from . import test_utils

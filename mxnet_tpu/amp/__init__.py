@@ -1,0 +1,171 @@
+"""Automatic mixed precision.
+
+TPU-native re-design of the reference AMP
+(``python/mxnet/contrib/amp/amp.py :: init, init_trainer, scale_loss,
+convert_hybrid_block``).  The reference monkey-patches every generated op
+wrapper to insert casts; here every op dispatch -- eager AND inside
+hybridize/TrainStep traces -- flows through ``ndarray.invoke``, so AMP is
+one policy hook at that chokepoint, driven by the same three cast lists
+(``amp/lists.py``).
+
+Design (bf16-first):
+
+- ``target_dtype='bfloat16'`` (default): parameters stay fp32; inputs of
+  MXU-bound ops (conv/matmul) are cast to bf16 at the op boundary, and the
+  cast's vjp returns fp32 gradients -- fp32 master weights for free, no
+  loss scaling needed (bf16 keeps fp32's exponent).  This is the standard
+  TPU mixed-precision recipe.
+- ``target_dtype='float16'``: same casting, plus ``LossScaler`` dynamic
+  loss scaling wired into ``Trainer`` via ``init_trainer``/``scale_loss``
+  (reference semantics: skip the update on overflow, scale *= 2 every 2k
+  clean steps, /= 2 on overflow).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from . import lists
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "scope", "is_active", "target_dtype", "init_trainer",
+           "scale_loss", "unscale", "convert_hybrid_block", "LossScaler",
+           "policy_token", "apply_op_casts", "lists"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "dtype"):
+        _state.dtype = None
+    return _state
+
+
+def init(target_dtype="bfloat16"):
+    """Globally activate mixed precision (reference: ``amp.init``)."""
+    td = np.dtype(jnp.bfloat16.dtype) if str(target_dtype) == "bfloat16" \
+        else np.dtype(target_dtype)
+    if td not in (np.dtype(jnp.bfloat16.dtype), np.dtype(np.float16)):
+        raise MXNetError("amp target_dtype must be bfloat16 or float16, "
+                         "got %r" % (target_dtype,))
+    _st().dtype = td
+
+
+def shutdown():
+    """Deactivate AMP (not in the reference; kept for test/bench hygiene)."""
+    _st().dtype = None
+
+
+@contextlib.contextmanager
+def scope(target_dtype="bfloat16"):
+    """Scoped AMP activation (TPU-native convenience)."""
+    prev = _st().dtype
+    init(target_dtype)
+    try:
+        yield
+    finally:
+        _state.dtype = prev
+
+
+def is_active():
+    return _st().dtype is not None
+
+
+def target_dtype():
+    return _st().dtype
+
+
+def policy_token():
+    """Hashable token for jit-cache keys (hybridize / TrainStep)."""
+    d = _st().dtype
+    return str(d) if d is not None else None
+
+
+_TARGET_OPS = frozenset(lists.TARGET_DTYPE_OPS)
+_FP32_OPS = frozenset(lists.FP32_OPS)
+_WIDEST_OPS = frozenset(lists.WIDEST_TYPE_CASTS)
+_F32 = np.dtype(np.float32)
+
+
+def _is_float(d):
+    return d in (_F32, np.dtype(np.float16), np.dtype(jnp.bfloat16.dtype))
+
+
+def apply_op_casts(op_name, datas):
+    """Cast an op's tensor inputs per the active policy.  Called from
+    ``ndarray.invoke`` (the one dispatch chokepoint)."""
+    td = _st().dtype
+    if td is None:
+        return datas
+    if op_name in _TARGET_OPS:
+        return [d if d is None or not _is_float(np.dtype(d.dtype))
+                else d.astype(td) for d in datas]
+    if op_name in _FP32_OPS:
+        return [d if d is None or not _is_float(np.dtype(d.dtype))
+                else d.astype(_F32) for d in datas]
+    if op_name in _WIDEST_OPS:
+        dts = [np.dtype(d.dtype) for d in datas if d is not None]
+        if any(dt == _F32 for dt in dts) and \
+                any(_is_float(dt) and dt != _F32 for dt in dts):
+            return [d if d is None or not _is_float(np.dtype(d.dtype))
+                    else d.astype(_F32) for d in datas]
+    return datas
+
+
+# ----------------------------------------------------------------------
+# Trainer integration (fp16 loss scaling; reference amp.py semantics)
+# ----------------------------------------------------------------------
+
+def init_trainer(trainer, loss_scaler=None):
+    """Attach dynamic loss scaling to a Trainer (reference:
+    ``amp.init_trainer``)."""
+    trainer._amp_loss_scaler = loss_scaler or LossScaler()
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """Yield the scaled loss for backward (reference: ``amp.scale_loss``)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Divide accumulated gradients by the current loss scale (reference:
+    ``amp.unscale``).  Marks the trainer so ``step()`` does not divide a
+    second time."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p.grad_or_none
+        if g is not None:
+            g._data = g._data * inv
+    trainer._amp_unscaled = True
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None):
+    """Return the block configured for mixed-precision inference/training
+    (reference: ``amp.convert_hybrid_block`` rewrites the symbol graph;
+    here activation is the dispatch policy, so conversion = activate +
+    drop stale compiled entries)."""
+    init(target_dtype)
+    def _clear(b):
+        if hasattr(b, "_cached_entries"):
+            object.__setattr__(b, "_cached_entries", {})
+        for c in b._children.values():
+            _clear(c)
+    _clear(block)
+    return block

@@ -1,0 +1,42 @@
+"""Dynamic loss scaling for fp16 AMP.
+
+Reference: ``python/mxnet/contrib/amp/loss_scaler.py :: LossScaler`` --
+scale doubles every ``scale_window`` clean steps, halves on overflow
+(detected with the ``multi_all_finite`` op).  bfloat16 shares fp32's
+exponent range, so bf16 mode does not need scaling; this exists for fp16
+parity and for users porting fp16 recipes.
+"""
+from __future__ import annotations
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._min_scale = float(min_scale)
+        self._unskipped = 0
+
+    def has_overflow(self, grad_arrays):
+        """True if any gradient contains inf/nan (reference: ``multi_all_finite``)."""
+        from ..ndarray import invoke
+        from ..ops.registry import get_op
+        grads = [g for g in grad_arrays if g is not None]
+        if not grads:
+            return False
+        ok = invoke(get_op("multi_all_finite"), grads,
+                    {"num_arrays": len(grads)})
+        return not bool(float(ok.asnumpy()[0]))
+
+    def update_scale(self, overflow):
+        """Adjust after a step (reference: ``LossScaler.update_scale``)."""
+        if overflow:
+            self.loss_scale = max(self._min_scale,
+                                  self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -420,7 +420,9 @@ class HybridBlock(Block):
             return self._forward_impl(*args)
         training = autograd.is_training()
         recording = autograd.is_recording()
-        key = (training,) + tuple((a.shape, str(a.dtype)) for a in args)
+        from .. import amp as _amp
+        key = (training, _amp.policy_token()) + \
+            tuple((a.shape, str(a.dtype)) for a in args)
         entry = self._cached_entries.get(key)
         if entry is None:
             entry = self._build_cache(args, training)

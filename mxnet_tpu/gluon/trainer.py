@@ -75,6 +75,23 @@ class Trainer:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            # fp16 AMP: fold 1/loss_scale into the update's rescale
+            # (unless amp.unscale already divided the grads), and skip the
+            # whole update on overflow.  The check runs on POST-allreduce
+            # gradients: the cross-device sum itself can overflow
+            # (reference: amp.init_trainer + LossScaler semantics).
+            if not getattr(self, "_amp_unscaled", False):
+                self._optimizer.rescale_grad /= scaler.loss_scale
+            self._amp_unscaled = False
+            grads = [p._data._grad for p in self._params
+                     if p.grad_req != "null" and p._data is not None
+                     and p._data._grad is not None]
+            overflow = scaler.has_overflow(grads)
+            scaler.update_scale(overflow)
+            if overflow:
+                return
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
@@ -97,6 +114,7 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        updatable = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null" or p._data is None:
                 continue
@@ -105,7 +123,61 @@ class Trainer:
                     continue
                 raise MXNetError("parameter %s has no gradient; run "
                                  "backward first" % p.name)
+            updatable.append((i, p))
+        if self._try_fused_update(updatable):
+            return
+        for i, p in updatable:
             self._updater(i, p._data._grad, p._data)
+
+    def _try_fused_update(self, updatable):
+        """Group plain-SGD updates into ``multi_sgd(_mom)_update`` calls so
+        an N-layer model costs O(N / aggregate_num) dispatches instead of
+        O(N) (reference: ``optimizer_op.cc :: multi_sgd_update`` +
+        ``MXNET_OPTIMIZER_AGGREGATION_SIZE``)."""
+        import os
+        from .. import ndarray as nd
+        o = self._optimizer
+        if type(o) is not opt.SGD or o.multi_precision or len(updatable) < 2:
+            return False
+        agg = int(os.environ.get("MXNET_OPTIMIZER_AGGREGATION_SIZE", 60))
+        if agg < 2:
+            return False
+        upd = self._updater
+        clip = o.clip_gradient if o.clip_gradient is not None else -1.0
+        for s in range(0, len(updatable), agg):
+            chunk = updatable[s:s + agg]
+            lrs, wds = [], []
+            for i, p in chunk:
+                o._update_count(i)
+                lrs.append(o._get_lr(i))
+                wds.append(o._get_wd(i))
+            n = len(chunk)
+            if o.momentum != 0.0:
+                for i, p in chunk:
+                    if i not in upd.states:
+                        upd.states[i] = \
+                            o.create_state_multi_precision(i, p._data)
+                data = []
+                for i, p in chunk:
+                    data += [p._data, p._data._grad, upd.states[i]]
+                outs = nd.multi_sgd_mom_update(
+                    *data, lrs=tuple(lrs), wds=tuple(wds),
+                    momentum=o.momentum, rescale_grad=o.rescale_grad,
+                    clip_gradient=clip, num_weights=n)
+                for k, (i, p) in enumerate(chunk):
+                    p._data._data = outs[k]._data
+                    upd.states[i]._data = outs[n + k]._data
+            else:
+                data = []
+                for i, p in chunk:
+                    data += [p._data, p._data._grad]
+                outs = nd.multi_sgd_update(
+                    *data, lrs=tuple(lrs), wds=tuple(wds),
+                    rescale_grad=o.rescale_grad, clip_gradient=clip,
+                    num_weights=n)
+                for k, (i, p) in enumerate(chunk):
+                    p._data._data = outs[k]._data
+        return True
 
     def save_states(self, fname):
         """Reference: ``Trainer.save_states`` -- optimizer state blob."""

@@ -68,6 +68,12 @@ def _is_traced(x):
     return isinstance(x, jax.core.Tracer)
 
 
+def _amp_active():
+    import sys
+    amp_mod = sys.modules.get("mxnet_tpu.amp")
+    return amp_mod is not None and amp_mod.is_active()
+
+
 class NDArray:
     """An n-dimensional array on a device context."""
 
@@ -548,6 +554,11 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
         full = list(datas)
         for i, d in zip(present, pd):
             full[i] = d
+        if _amp_active():
+            # AMP casts go INSIDE the differentiated function so the cast's
+            # vjp returns fp32 gradients (fp32 master weights for free).
+            from .. import amp as _amp
+            full = _amp.apply_op_casts(op.name, full)
         return fn(*full, **params)
 
     recording = autograd.is_recording() and any(

@@ -207,31 +207,42 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     reduce_axes = tuple(i for i in range(data.ndim) if i != axis)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
+    # Stats accumulate in fp32 even for bf16 activations (AMP): the
+    # upcast fuses into the reduction, so activations stay bf16 in HBM
+    # while the mean/var math is exact enough.
+    xf = data.astype(jnp.float32)
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var
-    inv = lax.rsqrt(var + eps) * g
-    out = (data - mean.reshape(bshape)) * inv.reshape(bshape) + beta.reshape(bshape)
-    return out, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
+    inv = (lax.rsqrt(var + eps) * g).astype(jnp.float32)
+    out = (xf - mean.reshape(bshape).astype(jnp.float32)) \
+        * inv.reshape(bshape) + beta.reshape(bshape).astype(jnp.float32)
+    return (out.astype(data.dtype), lax.stop_gradient(new_mean),
+            lax.stop_gradient(new_var))
 
 
 @register("LayerNorm", args=("data", "gamma", "beta"))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     """Layer normalization (reference: ``src/operator/nn/layer_norm.cc``).
 
-    Written so XLA fuses the whole thing into one elementwise pass.
+    Written so XLA fuses the whole thing into one elementwise pass; stats
+    accumulate in fp32 for bf16 activations (cast fuses into the
+    reduction).
     """
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.mean(jnp.square(data - mean), axis=axis, keepdims=True)
-    out = (data - mean) * lax.rsqrt(var + eps)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axis, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
-    return out * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = out * gamma.reshape(bshape).astype(jnp.float32) \
+        + beta.reshape(bshape).astype(jnp.float32)
+    return out.astype(data.dtype)
 
 
 @register("InstanceNorm", args=("data", "gamma", "beta"))

@@ -216,3 +216,85 @@ def _multi_all_finite(*data, num_arrays=1, init_output=True):
     for a in data:
         ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
     return ok.astype(jnp.float32).reshape(1)
+
+
+@register("lars_update", args=("weight", "grad", "mom"))
+def _lars_update(weight, grad, mom, lr=0.01, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """LARS layer-wise adaptive SGD (reference: ``optimizer_op.cc`` LARS
+    path / ``optimizer/contrib :: LARS``): the learning rate is scaled by
+    the trust ratio eta*||w|| / (||g|| + wd*||w|| + eps) per tensor."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(weight)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    trust = jnp.where(
+        jnp.logical_and(w_norm > 0, g_norm > 0),
+        eta * w_norm / (g_norm + wd * w_norm + epsilon), 1.0)
+    lr_adj = lr * trust
+    new_mom = momentum * mom + lr_adj * (g + wd * weight)
+    return weight - new_mom, new_mom
+
+
+def _multi_groups(data, group_size, num_weights):
+    n = num_weights if num_weights > 0 else len(data) // group_size
+    return [data[i * group_size:(i + 1) * group_size] for i in range(n)]
+
+
+@register("multi_sgd_update", args=("data",), variadic=True)
+def _multi_sgd_update(*data, lrs=(), wds=(), rescale_grad=1.0,
+                      clip_gradient=-1.0, num_weights=-1):
+    """Group SGD over interleaved [w0,g0,w1,g1,...] (reference:
+    ``optimizer_op.cc :: multi_sgd_update``): one dispatch updates every
+    weight -- under jit the whole group fuses into one XLA program."""
+    outs = []
+    for i, (w, g) in enumerate(_multi_groups(data, 2, num_weights)):
+        outs.append(_sgd_update.fcompute(
+            w, g, lr=lrs[i], wd=wds[i], rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", args=("data",), variadic=True)
+def _multi_sgd_mom_update(*data, lrs=(), wds=(), momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_weights=-1):
+    """Group momentum SGD over [w0,g0,m0,w1,g1,m1,...]; returns
+    (w0',w1',...,m0',m1',...) (reference: ``multi_sgd_mom_update``)."""
+    ws, ms = [], []
+    for i, (w, g, m) in enumerate(_multi_groups(data, 3, num_weights)):
+        nw, nm = _sgd_mom_update.fcompute(
+            w, g, m, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        ws.append(nw)
+        ms.append(nm)
+    return tuple(ws + ms)
+
+
+@register("multi_mp_sgd_update", args=("data",), variadic=True)
+def _multi_mp_sgd_update(*data, lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=-1):
+    """Group multi-precision SGD over [w0,g0,w32_0,...]; returns
+    (w...,w32...) (reference: ``multi_mp_sgd_update``)."""
+    ws, w32s = [], []
+    for i, (w, g, w32) in enumerate(_multi_groups(data, 3, num_weights)):
+        nw, nw32 = _mp_sgd_update.fcompute(
+            w, g, w32, lr=lrs[i], wd=wds[i], rescale_grad=rescale_grad,
+            clip_gradient=clip_gradient)
+        ws.append(nw)
+        w32s.append(nw32)
+    return tuple(ws + w32s)
+
+
+@register("multi_lars", args=("lrs", "weights_sum_sq", "grads_sum_sq", "wds"))
+def _multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+                eps=1e-9, rescale_grad=1.0):
+    """Vectorized LARS trust-ratio lr adjustment over stacked per-tensor
+    norms (reference: ``multi_lars.cc``)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        jnp.logical_and(w_norm > 0, g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust

@@ -181,6 +181,43 @@ class SGD(Optimizer):
 
 
 @register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling for large-batch SGD (reference:
+    ``optimizer/contrib :: LARS``; BASELINE config 5).  Dispatches to the
+    fused ``lars_update`` op (trust ratio + momentum step in one
+    program)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9,
+                 skip_list=("bias", "gamma", "beta"), **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+        self.skip_list = tuple(skip_list)
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
+
+    def _skip_lars(self, index):
+        # The reference excludes biases and norm-layer scales from the
+        # trust-ratio adaptation (their norms are tiny and unstable).
+        p = self.param_dict.get(index)
+        name = p.name if p is not None else str(self.idx2name.get(index, ""))
+        return name.endswith(self.skip_list)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if self._skip_lars(index):
+            w, m = nd.sgd_mom_update(weight, grad, state,
+                                     momentum=self.momentum, **kw)
+        else:
+            w, m = nd.lars_update(weight, grad, state, momentum=self.momentum,
+                                  eta=self.eta, epsilon=self.epsilon, **kw)
+        weight._data, state._data = w._data, m._data
+
+
+@register
 class NAG(SGD):
     """Nesterov accelerated SGD (reference: ``NAG``)."""
 

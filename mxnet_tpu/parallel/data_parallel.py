@@ -158,6 +158,19 @@ def _wrap_state(s):
     return NDArray(s)
 
 
+def _select_state(pred, new, old):
+    """Elementwise select between updated and original optimizer state
+    trees (new leaves are NDArray-wrapped, old leaves raw arrays)."""
+    if new is None:
+        return None
+    if isinstance(new, (tuple, list)):
+        return tuple(_select_state(pred, n, o) for n, o in zip(new, old))
+    nv = new._data if isinstance(new, NDArray) else new
+    ov = old._data if isinstance(old, NDArray) else old
+    import jax.numpy as _jnp
+    return _jnp.where(pred, nv, ov)
+
+
 def _state_leaves(s):
     if s is None:
         return []
@@ -232,7 +245,8 @@ class TrainStep:
         idxs = self._diff_indices()
         pure_fn, pnames, pmap = block.functionalize(training=training)
         name_by_idx = {i: tr._params[i].name for i in idxs}
-        def step_fn(pvals, svals, data, label, rng, t, lrs, wds, rescale):
+        def step_fn(pvals, svals, data, label, rng, t, lrs, wds, rescale,
+                    loss_scale):
             def loss_of(diff_pvals):
                 merged = dict(pvals)
                 merged.update(diff_pvals)
@@ -243,13 +257,23 @@ class TrainStep:
                 ldata = l._data if isinstance(l, NDArray) else l
                 # Sum (not mean): the reference seeds backward with ones
                 # over the batch loss and rescales by 1/batch_size in the
-                # optimizer (Trainer.step semantics).
-                return jnp.sum(ldata), (jnp.mean(ldata), aux)
+                # optimizer (Trainer.step semantics).  loss_scale is the
+                # fp16 AMP scale (1.0 otherwise); rescale folds in its
+                # inverse.
+                return jnp.sum(ldata) * loss_scale, (jnp.mean(ldata), aux)
 
             diff_pvals = {name_by_idx[i]: pvals[name_by_idx[i]] for i in idxs}
             grads_and_aux = jax.value_and_grad(loss_of, has_aux=True)(
                 diff_pvals)
             (_, (mean_loss, aux)), grads = grads_and_aux
+
+            # Branchless fp16 overflow skip: if any gradient is non-finite
+            # the select below keeps the old weights/states (the XLA
+            # answer to the reference's skip-update-on-overflow).
+            all_finite = jnp.bool_(True)
+            for leaf in jax.tree_util.tree_leaves(grads):
+                all_finite = jnp.logical_and(all_finite,
+                                             jnp.all(jnp.isfinite(leaf)))
 
             lr_map = {i: lrs[k] for k, i in enumerate(idxs)}
             wd_map = {i: wds[k] for k, i in enumerate(idxs)}
@@ -265,11 +289,9 @@ class TrainStep:
                     g = NDArray(grads[nm])
                     s = _wrap_state(svals.get(i))
                     opt.update_multi_precision(i, w, g, s)
-                    new_w[nm] = w._data
-                    new_s[i] = jax.tree_util.tree_map(
-                        lambda x: x._data if isinstance(x, NDArray) else x, s,
-                        is_leaf=lambda x: isinstance(x, NDArray) or x is None)
-            return new_w, new_s, aux, mean_loss
+                    new_w[nm] = jnp.where(all_finite, w._data, pvals[nm])
+                    new_s[i] = _select_state(all_finite, s, svals.get(i))
+            return new_w, new_s, aux, mean_loss, all_finite
 
         jit_kwargs = {}
         if self._mesh is not None:
@@ -284,7 +306,7 @@ class TrainStep:
             label_sh = _batch_sharding(mesh, len(ivals[1].shape),
                                        0, self._axis_name)
             jit_kwargs["in_shardings"] = (
-                None, None, data_sh, label_sh, rep, rep, rep, rep, rep)
+                None, None, data_sh, label_sh, rep, rep, rep, rep, rep, rep)
         if self._donate:
             jit_kwargs["donate_argnums"] = (0, 1)
         return jax.jit(step_fn, **jit_kwargs), idxs, pnames, pmap
@@ -318,8 +340,9 @@ class TrainStep:
             self._ensure_states()
 
         training = True
+        from .. import amp as _amp
         key = (tuple(data.shape), str(data.dtype), tuple(label.shape),
-               str(label.dtype), training)
+               str(label.dtype), training, _amp.policy_token())
         entry = self._cache.get(key)
         if entry is None:
             entry = self._build([data, label], training)
@@ -337,7 +360,10 @@ class TrainStep:
         wds = jnp.asarray([opt._get_wd(i) for i in idxs], jnp.float32)
         bs = batch_size if batch_size is not None \
             else data.shape[self._batch_axis]
-        rescale = jnp.asarray(tr._scale / bs, jnp.float32)
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        ls = scaler.loss_scale if scaler is not None else 1.0
+        rescale = jnp.asarray(tr._scale / bs / ls, jnp.float32)
+        loss_scale = jnp.asarray(ls, jnp.float32)
 
         upd = tr._updater
         pvals = {n: pmap[n]._data._data for n in pnames}
@@ -348,9 +374,13 @@ class TrainStep:
             for i in idxs}
         rng = _random_mod.next_key()
 
-        new_w, new_s, aux, mean_loss = fn(pvals, svals, data._data,
-                                          label._data, rng, t, lrs, wds,
-                                          rescale)
+        new_w, new_s, aux, mean_loss, all_finite = fn(
+            pvals, svals, data._data, label._data, rng, t, lrs, wds,
+            rescale, loss_scale)
+        if scaler is not None:
+            # host sync only in fp16 mode: the scaler's growth/backoff
+            # counters live on the host (reference LossScaler semantics)
+            scaler.update_scale(not bool(np.asarray(all_finite)))
 
         # rebind updated weights/states/aux into the framework objects
         # (ALL params: buffers were donated, unchanged ones aliased through)

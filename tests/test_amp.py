@@ -1,0 +1,336 @@
+"""AMP (mixed precision), LARS, and fused multi-tensor optimizer updates.
+
+Reference analogs: ``tests/python/unittest/test_amp.py``, LARS/LAMB tests,
+``multi_sgd_update`` kernels in ``optimizer_op.cc``."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+from mxnet_tpu.base import MXNetError
+
+
+def _mlp(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    return net
+
+
+def test_amp_casts_matmul_to_bf16():
+    with amp.scope("bfloat16"):
+        a = mx.nd.ones((4, 8))
+        b = mx.nd.ones((8, 4))
+        out = mx.nd.dot(a, b)
+        assert out.dtype == np.dtype(jnp.bfloat16.dtype)
+    # outside the scope: fp32 again
+    out2 = mx.nd.dot(a, b)
+    assert out2.dtype == np.float32
+
+
+def test_amp_fp32_ops_stay_fp32():
+    with amp.scope("bfloat16"):
+        x = mx.nd.ones((4, 8)).astype("bfloat16")
+        s = mx.nd.softmax(x)
+        assert s.dtype == np.float32  # FP32_OPS list
+
+
+def test_amp_params_keep_fp32_master_grads():
+    """bf16 compute, fp32 weights and fp32 gradients (the cast's vjp)."""
+    net = _mlp()
+    loss_fn = gluon.loss.L2Loss()
+    X = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    Y = mx.nd.array(np.random.RandomState(1).randn(8, 4).astype(np.float32))
+    with amp.scope("bfloat16"):
+        with autograd.record():
+            l = loss_fn(net(X), Y)
+        l.backward()
+    for p in net.collect_params().values():
+        assert p.data().dtype == np.float32
+        assert p.grad().dtype == np.float32
+        assert np.abs(p.grad().asnumpy()).sum() > 0
+
+
+def test_amp_bf16_training_converges():
+    net = _mlp(seed=3)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(2)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 4).astype(np.float32)
+    losses = []
+    with amp.scope("bfloat16"):
+        for _ in range(40):
+            x, y = mx.nd.array(X), mx.nd.array(Y)
+            with autograd.record():
+                l = loss_fn(net(x), y)
+            l.backward()
+            trainer.step(64)
+            losses.append(float(l.mean().asscalar()))
+    assert losses[-1] < losses[0] / 3, (losses[0], losses[-1])
+
+
+def test_amp_trainstep_compiled_bf16():
+    from mxnet_tpu.parallel import TrainStep
+    net = _mlp(seed=5)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer)
+    rng = np.random.RandomState(4)
+    X = rng.randn(32, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 4).astype(np.float32)
+    with amp.scope("bfloat16"):
+        first = float(step(mx.nd.array(X), mx.nd.array(Y)).asscalar())
+        for _ in range(80):
+            last = float(step(mx.nd.array(X), mx.nd.array(Y)).asscalar())
+    assert last < first / 3
+    for p in net.collect_params().values():
+        assert p.data().dtype == np.float32
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=1024.0, scale_window=2)
+    assert not s.has_overflow([mx.nd.ones((3,))])
+    assert s.has_overflow([mx.nd.ones((3,)),
+                           mx.nd.array(np.array([np.inf, 1, 2],
+                                                np.float32))])
+    s.update_scale(True)
+    assert s.loss_scale == 512.0
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 1024.0
+
+
+def test_fp16_trainer_skips_on_overflow():
+    net = _mlp(seed=7)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    amp.init_trainer(trainer, amp.LossScaler(init_scale=4.0))
+    loss_fn = gluon.loss.L2Loss()
+    X = mx.nd.array(np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    Y = mx.nd.zeros((8, 4))
+    with autograd.record():
+        l = loss_fn(net(X), Y)
+    l.backward()
+    # poison one gradient with inf: the whole update must be skipped
+    p0 = list(net.collect_params().values())[0]
+    before = {p.name: p.data().asnumpy().copy()
+              for p in net.collect_params().values()}
+    p0.grad()._data = (p0.grad()._data * np.inf)
+    trainer.step(8)
+    for p in net.collect_params().values():
+        np.testing.assert_array_equal(before[p.name], p.data().asnumpy())
+    assert trainer._amp_loss_scaler.loss_scale == 2.0  # halved
+
+
+def test_amp_scale_loss_context():
+    net = _mlp(seed=9)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01}, kvstore=None)
+    amp.init_trainer(trainer, amp.LossScaler(init_scale=8.0))
+    loss_fn = gluon.loss.L2Loss()
+    X = mx.nd.array(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    Y = mx.nd.zeros((4, 4))
+    with autograd.record():
+        l = loss_fn(net(X), Y)
+        with amp.scale_loss(l, trainer) as scaled:
+            scaled.backward()
+    g = list(net.collect_params().values())[0].grad().asnumpy()
+    # grads carry the 8x scale until step() folds in 1/scale
+    with autograd.record():
+        l2 = loss_fn(net(X), Y)
+    l2.backward()
+    g2 = list(net.collect_params().values())[0].grad().asnumpy()
+    np.testing.assert_allclose(g, 8.0 * g2, rtol=1e-5)
+
+
+def test_lars_optimizer_converges_and_uses_trust_ratio():
+    w, g, m = (mx.nd.array(np.full((4,), 2.0, np.float32)),
+               mx.nd.array(np.full((4,), 0.5, np.float32)),
+               mx.nd.zeros((4,)))
+    nw, nm = mx.nd.lars_update(w, g, m, lr=1.0, momentum=0.0, eta=0.1,
+                               wd=0.0)
+    # trust = eta*||w||/||g|| = 0.1*4/1 = 0.4 ; step = lr*trust*g = 0.2
+    np.testing.assert_allclose(nw.asnumpy(), 2.0 - 0.4 * 0.5, rtol=1e-5)
+
+    net = _mlp(seed=11)
+    trainer = gluon.Trainer(net.collect_params(), "lars",
+                            {"learning_rate": 1.0, "momentum": 0.9,
+                             "eta": 0.01}, kvstore=None)
+    loss_fn = gluon.loss.L2Loss()
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, 8).astype(np.float32)
+    Y = X @ rng.randn(8, 4).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        x, y = mx.nd.array(X), mx.nd.array(Y)
+        with autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(64)
+        losses.append(float(l.mean().asscalar()))
+    assert losses[-1] < losses[0] / 3
+
+
+def test_multi_sgd_matches_single():
+    rng = np.random.RandomState(0)
+    ws = [rng.randn(5, 3).astype(np.float32) for _ in range(3)]
+    gs = [rng.randn(5, 3).astype(np.float32) for _ in range(3)]
+    lrs, wds = (0.1, 0.2, 0.3), (0.0, 0.01, 0.1)
+    data = []
+    for w, g in zip(ws, gs):
+        data += [mx.nd.array(w), mx.nd.array(g)]
+    outs = mx.nd.multi_sgd_update(*data, lrs=lrs, wds=wds, num_weights=3)
+    for k in range(3):
+        ref = mx.nd.sgd_update(mx.nd.array(ws[k]), mx.nd.array(gs[k]),
+                               lr=lrs[k], wd=wds[k])
+        np.testing.assert_allclose(outs[k].asnumpy(), ref.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_multi_sgd_mom_matches_single():
+    rng = np.random.RandomState(1)
+    n = 3
+    ws = [rng.randn(4).astype(np.float32) for _ in range(n)]
+    gs = [rng.randn(4).astype(np.float32) for _ in range(n)]
+    ms = [rng.randn(4).astype(np.float32) for _ in range(n)]
+    lrs, wds = (0.1, 0.2, 0.3), (0.0, 0.01, 0.1)
+    data = []
+    for w, g, m in zip(ws, gs, ms):
+        data += [mx.nd.array(w), mx.nd.array(g), mx.nd.array(m)]
+    outs = mx.nd.multi_sgd_mom_update(*data, lrs=lrs, wds=wds, momentum=0.9,
+                                      num_weights=n)
+    for k in range(n):
+        rw, rm = mx.nd.sgd_mom_update(mx.nd.array(ws[k]), mx.nd.array(gs[k]),
+                                      mx.nd.array(ms[k]), lr=lrs[k],
+                                      wd=wds[k], momentum=0.9)
+        np.testing.assert_allclose(outs[k].asnumpy(), rw.asnumpy(), rtol=1e-6)
+        np.testing.assert_allclose(outs[n + k].asnumpy(), rm.asnumpy(),
+                                   rtol=1e-6)
+
+
+def test_fused_trainer_update_matches_per_param():
+    """Trainer's multi_sgd fused path must produce identical params to the
+    per-parameter updater path."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(16, 8).astype(np.float32)
+    Y = rng.randn(16, 4).astype(np.float32)
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(agg):
+        import os
+        os.environ["MXNET_OPTIMIZER_AGGREGATION_SIZE"] = str(agg)
+        try:
+            net = _mlp(seed=21)
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9,
+                                "wd": 0.01}, kvstore=None)
+            for _ in range(3):
+                with autograd.record():
+                    l = loss_fn(net(mx.nd.array(X)), mx.nd.array(Y))
+                l.backward()
+                tr.step(16)
+            return [p.data().asnumpy()
+                    for p in net.collect_params().values()]
+        finally:
+            del os.environ["MXNET_OPTIMIZER_AGGREGATION_SIZE"]
+
+    fused = run(60)
+    unfused = run(1)  # agg < 2 disables the fused path
+    for a, b in zip(fused, unfused):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_amp_init_rejects_bad_dtype():
+    with pytest.raises(MXNetError):
+        amp.init("float64")
+
+
+def test_unscale_then_step_no_double_divide():
+    """amp.unscale followed by trainer.step must divide by the loss scale
+    exactly once."""
+    def run(use_unscale):
+        net = _mlp(seed=31)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore=None)
+        X = mx.nd.array(np.random.RandomState(0).randn(8, 8)
+                        .astype(np.float32))
+        Y = mx.nd.array(np.random.RandomState(1).randn(8, 4)
+                        .astype(np.float32))
+        loss_fn = gluon.loss.L2Loss()
+        if use_unscale:
+            amp.init_trainer(tr, amp.LossScaler(init_scale=1024.0,
+                                                scale_window=10**9))
+            with autograd.record():
+                l = loss_fn(net(X), Y)
+                with amp.scale_loss(l, tr) as sl:
+                    sl.backward()
+            amp.unscale(tr)
+        else:
+            with autograd.record():
+                l = loss_fn(net(X), Y)
+            l.backward()
+        tr.step(8)
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    plain = run(False)
+    scaled = run(True)
+    for a, b in zip(plain, scaled):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_trainstep_fp16_scaler_skips_and_backs_off():
+    """TrainStep must honor an attached loss scaler: overflowing steps
+    leave weights/states untouched and halve the scale."""
+    from mxnet_tpu.parallel import TrainStep
+    net = _mlp(seed=33)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=None)
+    amp.init_trainer(tr, amp.LossScaler(init_scale=8.0, scale_window=10**9))
+    step = TrainStep(net, gluon.loss.L2Loss(), tr)
+    X = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    Y = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    step(mx.nd.array(X), mx.nd.array(Y))  # clean step
+    assert tr._amp_loss_scaler.loss_scale == 8.0
+    before = [p.data().asnumpy().copy()
+              for p in net.collect_params().values()]
+    bad = X.copy()
+    bad[0, 0] = np.inf  # forward produces non-finite grads
+    step(mx.nd.array(bad), mx.nd.array(Y))
+    assert tr._amp_loss_scaler.loss_scale == 4.0  # backed off
+    after = [p.data().asnumpy() for p in net.collect_params().values()]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)  # update skipped
+
+
+def test_trainstep_fp16_scaler_matches_unscaled_updates():
+    """With a scaler attached and no overflow, TrainStep updates must match
+    the no-scaler run (scale cancels exactly)."""
+    from mxnet_tpu.parallel import TrainStep
+    X = np.random.RandomState(2).randn(16, 8).astype(np.float32)
+    Y = np.random.RandomState(3).randn(16, 4).astype(np.float32)
+
+    def run(with_scaler):
+        net = _mlp(seed=35)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1}, kvstore=None)
+        if with_scaler:
+            amp.init_trainer(tr, amp.LossScaler(init_scale=256.0,
+                                                scale_window=10**9))
+        step = TrainStep(net, gluon.loss.L2Loss(), tr)
+        for _ in range(3):
+            step(mx.nd.array(X), mx.nd.array(Y))
+        return [p.data().asnumpy() for p in net.collect_params().values()]
+
+    a, b = run(False), run(True)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-6)
