@@ -92,6 +92,52 @@ def bench_resnet50(batch_size=128, dtype="float32"):
                         batch_size, warmup=5, iters=20, dtype=dtype)
 
 
+def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
+                    dtype="float32", use_flash=True, iters=20):
+    """BERT-base masked-LM pretraining step, tokens/s (config 3)."""
+    import contextlib
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.parallel import TrainStep
+
+    ctx = _ctx()
+    mx.random.seed(0)
+    net = gluon.model_zoo.bert_base(vocab_size=vocab, max_length=seq_len,
+                                    dropout=0.0, use_flash=use_flash)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class MLMLoss(gluon.HybridBlock):
+        def hybrid_forward(self, F, outs, labels):
+            mlm, _nsp = outs
+            return ce(mlm.reshape((-1, vocab)), labels.reshape((-1,)))
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-4}, kvstore=None)
+    step = TrainStep(net, MLMLoss(), trainer, mesh=None)
+    rng = np.random.RandomState(0)
+    ids = mx.nd.array(
+        rng.randint(0, vocab, (batch_size, seq_len)).astype(np.float32),
+        ctx=ctx)
+    labels = mx.nd.array(
+        rng.randint(0, vocab, (batch_size, seq_len)).astype(np.float32),
+        ctx=ctx)
+    amp_ctx = amp.scope(dtype) if dtype != "float32" \
+        else contextlib.nullcontext()
+    with amp_ctx:
+        for _ in range(5):
+            step(ids, labels)
+        float(step(ids, labels).asscalar())
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(iters):
+            last = step(ids, labels)
+        float(last.asscalar())
+        dt = time.perf_counter() - t0
+    return batch_size * seq_len * iters / dt
+
+
 def main():
     import mxnet_tpu as mx
     results = {}
@@ -126,6 +172,20 @@ def main():
     except Exception as e:  # bf16 path optional until AMP lands fully
         print(json.dumps({"metric": "resnet50_imagenet_train_bf16",
                           "error": str(e)[:200]}))
+
+    try:
+        bert_bs = 16 if on_tpu else 2
+        bert_seq = 128 if on_tpu else 32
+        bert_iters = 20 if on_tpu else 3
+        for dt_name in (("bfloat16",) if on_tpu else ("float32",)):
+            tok = bench_bert_base(bert_bs, bert_seq, dtype=dt_name,
+                                  iters=bert_iters)
+            results["bert_base_%s" % dt_name] = tok
+            print(json.dumps({"metric": "bert_base_pretrain_%s" % dt_name,
+                              "value": round(tok, 1), "unit": "tokens/s",
+                              "vs_baseline": None}))
+    except Exception as e:
+        print(json.dumps({"metric": "bert_base_pretrain", "error": str(e)[:200]}))
 
     # BASELINE.md anchor: MXNet-CUDA A100 ResNet-50 ~3000 img/s (AMP+DALI)
     baseline = 3000.0

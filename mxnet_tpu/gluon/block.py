@@ -48,10 +48,15 @@ from .parameter import (DeferredInitializationError, Parameter, ParameterDict,
 _naming = threading.local()
 
 
-def _block_counters():
+def _naming_state():
     if not hasattr(_naming, "counters"):
         _naming.counters = [{}]
-    return _naming.counters[-1]
+        _naming.prefixes = [""]
+    return _naming
+
+
+def _block_counters():
+    return _naming_state().counters[-1]
 
 
 _trace_tls = threading.local()
@@ -84,12 +89,16 @@ class Block:
 
     def __init__(self, prefix=None, params=None):
         self._empty_init()
-        counters = _block_counters()
+        st = _naming_state()
+        counters = st.counters[-1]
         if prefix is None:
+            # Auto names are scoped: a block created inside a parent's
+            # name_scope() gets the parent prefix prepended (reference
+            # semantics -- keeps repeated submodules' params distinct).
             hint = type(self).__name__.lower()
             idx = counters.get(hint, 0)
             counters[hint] = idx + 1
-            prefix = "%s%d_" % (hint, idx)
+            prefix = st.prefixes[-1] + "%s%d_" % (hint, idx)
         self._prefix = prefix
         self._scope_params = ParameterDict(prefix, shared=params)
 
@@ -125,12 +134,14 @@ class Block:
 
         @contextlib.contextmanager
         def _scope():
-            _block_counters()  # ensure initialized
-            _naming.counters.append({})
+            st = _naming_state()
+            st.counters.append({})
+            st.prefixes.append(self._prefix)
             try:
                 yield self
             finally:
-                _naming.counters.pop()
+                st.counters.pop()
+                st.prefixes.pop()
         return _scope()
 
     # -- parameter management -----------------------------------------

@@ -1,3 +1,5 @@
 """``gluon.model_zoo`` (reference: ``python/mxnet/gluon/model_zoo/``)."""
 from . import vision
 from .vision import get_model
+from . import bert
+from .bert import BERTModel, bert_base, bert_small, get_bert

@@ -1,0 +1,171 @@
+"""Transformer layers: MultiHeadAttention, PositionwiseFFN, encoder cells.
+
+TPU-native re-design of the attention stack the reference exposes through
+``src/operator/contrib/transformer.cc`` (interleaved matmul kernels) and
+GluonNLP's BERT blocks.  Layout is batch-major (batch, seq, units); heads
+fold into the batch dimension so every matmul is a large MXU-friendly
+``batch_dot``, and the score x value contraction can run through the
+Pallas flash-attention kernel (``ops/pallas/flash_attention.py``) when no
+padding mask is needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import HybridBlock
+from ..parameter import shape_is_known
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderCell", "TransformerEncoder"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross multi-head attention (reference kernels:
+    ``interleaved_matmul_selfatt_qk/valatt``).
+
+    ``use_flash=True`` routes the no-mask path through the Pallas flash
+    kernel on TPU; with a mask (or ``use_flash=False``) the XLA path
+    materializes masked scores (still fused by the compiler).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 use_flash=False, causal=False, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError("units %d not divisible by heads %d"
+                             % (units, num_heads))
+        self._units = units
+        self._heads = num_heads
+        self._dropout = dropout
+        self._use_flash = use_flash
+        self._causal = causal
+        with self.name_scope():
+            self.qkv_weight = self.params.get(
+                "qkv_weight", shape=(3 * units, 0), dtype=dtype,
+                allow_deferred_init=True)
+            self.out_weight = self.params.get(
+                "out_weight", shape=(units, units), dtype=dtype)
+            if use_bias:
+                self.qkv_bias = self.params.get(
+                    "qkv_bias", shape=(3 * units,), dtype=dtype,
+                    init="zeros")
+                self.out_bias = self.params.get(
+                    "out_bias", shape=(units,), dtype=dtype, init="zeros")
+            else:
+                self.qkv_bias = None
+                self.out_bias = None
+
+    def infer_shape(self, x, *args):
+        self.qkv_weight.shape = (3 * self._units, x.shape[-1])
+
+    def hybrid_forward(self, F, x, mask=None, qkv_weight=None, qkv_bias=None,
+                       out_weight=None, out_bias=None):
+        b, seq, _ = x.shape
+        h, hd = self._heads, self._units // self._heads
+        qkv = F.FullyConnected(x, qkv_weight, qkv_bias,
+                               num_hidden=3 * self._units,
+                               no_bias=qkv_bias is None, flatten=False)
+        # (b, seq, 3u) -> q/k/v each (b*h, seq, hd)
+        def heads_of(t):
+            t = t.reshape((b, seq, h, hd)).transpose((0, 2, 1, 3))
+            return t.reshape((b * h, seq, hd))
+        q = heads_of(F.slice_axis(qkv, axis=2, begin=0, end=self._units))
+        k = heads_of(F.slice_axis(qkv, axis=2, begin=self._units,
+                                  end=2 * self._units))
+        v = heads_of(F.slice_axis(qkv, axis=2, begin=2 * self._units,
+                                  end=3 * self._units))
+        if mask is None:
+            ctx_out = F.flash_attention(q, k, v, causal=self._causal,
+                                        use_pallas=self._use_flash)
+        else:
+            scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / hd ** 0.5)
+            # mask: (b, seq_q, seq_k) with 1 = attend; broadcast over heads
+            m = mask.reshape((b, 1, seq, seq)) \
+                .broadcast_to((b, h, seq, seq)).reshape((b * h, seq, seq))
+            scores = F.where(m, scores, F.ones_like(scores) * -1e30)
+            att = F.softmax(scores, axis=-1)
+            if self._dropout:
+                att = F.Dropout(att, p=self._dropout)
+            ctx_out = F.batch_dot(att, v)
+        out = ctx_out.reshape((b, h, seq, hd)).transpose((0, 2, 1, 3)) \
+            .reshape((b, seq, self._units))
+        return F.FullyConnected(out, out_weight, out_bias,
+                                num_hidden=self._units,
+                                no_bias=out_bias is None, flatten=False)
+
+
+class PositionwiseFFN(HybridBlock):
+    """Feed-forward block (BERT intermediate+output)."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        from .basic_layers import Dense, Dropout
+        self._dropout = dropout
+        with self.name_scope():
+            self.ffn_1 = Dense(hidden_size, activation=activation,
+                               flatten=False, in_units=units, dtype=dtype)
+            self.ffn_2 = Dense(units, flatten=False, in_units=hidden_size,
+                               dtype=dtype)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.drop(self.ffn_2(self.ffn_1(x)))
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN encoder cell (BERT style): LN(x + MHA(x)), LN(. + FFN(.))."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 use_flash=False, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        from .basic_layers import Dropout, LayerNorm
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout,
+                                                use_flash=use_flash,
+                                                dtype=dtype)
+            self.attn_drop = Dropout(dropout)
+            self.ln_1 = LayerNorm(in_channels=units)
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
+                                       dtype=dtype)
+            self.ln_2 = LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, mask=None):
+        att = self.attn_drop(self.attention(x, mask))
+        x = self.ln_1(x + att)
+        return self.ln_2(x + self.ffn(x))
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder cells with learned positional embedding."""
+
+    def __init__(self, units, hidden_size, num_layers, num_heads,
+                 max_length=512, dropout=0.0, use_flash=False,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        from .basic_layers import Dropout, LayerNorm
+        self._max_length = max_length
+        self._units = units
+        with self.name_scope():
+            self.position_weight = self.params.get(
+                "position_weight", shape=(max_length, units), dtype=dtype)
+            self.drop = Dropout(dropout)
+            self.ln = LayerNorm(in_channels=units)
+            self.cells = []
+            for i in range(num_layers):
+                cell = TransformerEncoderCell(units, hidden_size, num_heads,
+                                              dropout=dropout,
+                                              use_flash=use_flash,
+                                              dtype=dtype)
+                setattr(self, "cell%d" % i, cell)
+                self.cells.append(cell)
+
+    def hybrid_forward(self, F, x, mask=None, position_weight=None):
+        seq = x.shape[1]
+        pos = F.slice_axis(position_weight, axis=0, begin=0, end=seq)
+        x = x + pos.expand_dims(0)
+        x = self.drop(self.ln(x))
+        for cell in self.cells:
+            x = cell(x, mask)
+        return x

@@ -8,3 +8,4 @@ from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import transformer  # noqa: F401

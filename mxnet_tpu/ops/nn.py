@@ -291,6 +291,10 @@ def _activation(data, act_type="relu"):
         return jax.nn.log_sigmoid(data)
     if act_type == "mish":
         return data * jnp.tanh(jax.nn.softplus(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(data, approximate=True)
     raise MXNetError("Activation: bad act_type %r" % act_type)
 
 

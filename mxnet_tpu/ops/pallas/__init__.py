@@ -1,0 +1,4 @@
+"""Hand-written Pallas TPU kernels for hot ops (flash attention)."""
+from .flash_attention import flash_attention_fwd_pallas
+
+__all__ = ["flash_attention_fwd_pallas"]

@@ -1,0 +1,98 @@
+"""Flash attention forward kernel (Pallas/TPU).
+
+Replaces the reference's fused BERT attention kernels
+(``src/operator/contrib/transformer.cc :: interleaved_matmul_selfatt_*``,
+which materialize the (seq, seq) score matrix in HBM) with the blockwise
+online-softmax algorithm: scores never leave VMEM, so HBM traffic is
+O(seq*d) instead of O(seq^2) and long sequences stop being
+bandwidth-bound.
+
+Layout: (batch*heads, seq, head_dim) -- grid over (bh, q_block); each
+program streams KV blocks through VMEM with a running (max, sum, acc)
+carry.  fp32 accumulation regardless of input dtype (MXU-native bf16 in,
+fp32 out).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
+                seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)           # (block_q, d)
+    block_q = q.shape[0]
+    d = q.shape[1]
+
+    num_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        # only blocks at or left of the diagonal contribute
+        num_kv = pl.cdiv((qi + 1) * block_q, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+try:  # pallas import kept lazy-safe: CPU-only builds fall back to XLA
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_attention_fwd_pallas(q, k, v, causal=False, scale=1.0,
+                               block_q=256, block_k=256, interpret=False):
+    """q,k,v: (bh, seq, d) -> (bh, seq, d)."""
+    bh, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+    assert seq % block_q == 0 and seq % block_k == 0, \
+        "flash attention needs seq divisible by block sizes"
+    grid = (bh, seq // block_q)
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=scale, seq_len=seq)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
