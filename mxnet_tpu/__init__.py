@@ -58,4 +58,8 @@ from . import io
 from . import image
 from . import parallel
 from . import amp
+from . import model
+from . import callback
+from . import module
+from . import module as mod
 from . import test_utils

@@ -7,14 +7,28 @@ passes (InferShape, PlanMemory, AttachOpExecs) collapse into one
 scheduling.  Backward is the jitted vjp of the same function (replacing
 the nnvm Gradient pass), with ``grad_req`` write/add/null honored at the
 rebind step.
+
+Training forwards run ONE compiled program producing outputs, updated
+aux states (BatchNorm running stats write-back), and gradients under the
+default head cotangent -- so the ``forward(is_train=True); backward()``
+legacy protocol costs a single XLA dispatch per step.  An explicit
+``backward(out_grads=...)`` recomputes with the custom cotangent.
 """
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from .base import MXNetError
 from .ndarray import NDArray
 from .symbol.symbol import _eval_symbol
+
+
+class _W:
+    __slots__ = ("_data",)
+
+    def __init__(self, d):
+        self._data = d
 
 
 class Executor:
@@ -25,6 +39,7 @@ class Executor:
         self._symbol = symbol
         self._ctx = ctx
         self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
             args = dict(zip(self.arg_names, args))
         self.arg_dict = dict(args or {})
@@ -35,19 +50,35 @@ class Executor:
             self.grad_req = {n: grad_req for n in self.arg_names}
         else:
             self.grad_req = dict(grad_req)
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self.aux_names, aux_states))
         self.aux_dict = dict(aux_states or {})
         self.outputs = []
         self._fwd_jit = None
-        self._fwdbwd_jit = None
-        self._vjp = None
+        self._train_jit = None
+        self._last_train_args = None
+        self._pending_grads = None
 
-    def _pure(self, arg_vals):
-        class _W:
-            def __init__(self, d):
-                self._data = d
-        feed = {k: _W(v) for k, v in arg_vals.items()}
-        outs = _eval_symbol(self._symbol, feed)
-        return tuple(o._data for o in outs)
+    # ------------------------------------------------------------------
+    def _pure(self, vals, training):
+        """Pure graph walk: name->jax.Array in, (outputs, aux_updates)
+        out.  ``training`` is a trace-time static (two jit cache
+        entries, like the reference's train/eval CachedOp modes)."""
+        from . import autograd
+        feed = {k: _W(v) for k, v in vals.items()}
+        aux_updates = {} if training else None
+        prev = autograd.is_training()
+        autograd.set_training(training)
+        try:
+            outs = _eval_symbol(self._symbol, feed, aux_updates)
+        finally:
+            autograd.set_training(prev)
+        return tuple(o._data for o in outs), aux_updates or {}
+
+    def _all_vals(self):
+        vals = {k: v._data for k, v in self.arg_dict.items()}
+        vals.update({k: v._data for k, v in self.aux_dict.items()})
+        return vals
 
     def forward(self, is_train=False, **kwargs):
         """Run the graph (reference: ``GraphExecutor::RunOps``)."""
@@ -56,48 +87,52 @@ class Executor:
                 raise MXNetError("unknown input %r" % k)
             self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
                 else v
-        arg_vals = {k: v._data for k, v in self.arg_dict.items()}
+        vals = self._all_vals()
         if is_train:
             grad_names = [n for n in self.arg_names
                           if self.grad_req.get(n, "null") != "null"]
-
-            def split(av):
-                diff = {n: av[n] for n in grad_names}
-                nondiff = {n: av[n] for n in av if n not in diff}
-                return diff, nondiff
-
-            diff, nondiff = split(arg_vals)
-            if self._fwdbwd_jit is None:
-                def fwd(diff, nondiff):
-                    merged = dict(nondiff)
-                    merged.update(diff)
-                    return jax.vjp(lambda d: self._pure({**nondiff, **d}),
-                                   diff)
-                self._fwdbwd_jit = jax.jit(
-                    lambda d, nd: jax.vjp(
-                        lambda dd: self._pure({**nd, **dd}), d))
-                self._bwd_jit = jax.jit(lambda vjp, cts: vjp(cts))
-            outs, self._vjp = self._fwdbwd_jit(diff, nondiff)
+            diff = {n: vals[n] for n in grad_names}
+            nondiff = {n: v for n, v in vals.items() if n not in diff}
+            if self._train_jit is None:
+                def _train_step(diff, nondiff, cts):
+                    def f(dd):
+                        return self._pure({**nondiff, **dd}, True)
+                    outs, vjp, aux_up = jax.vjp(f, diff, has_aux=True)
+                    if cts is None:
+                        cts = tuple(jnp.ones(o.shape, o.dtype)
+                                    for o in outs)
+                    (grads,) = vjp(tuple(cts))
+                    return outs, aux_up, grads
+                self._train_jit = jax.jit(_train_step)
+            outs, aux_up, grads = self._train_jit(diff, nondiff, None)
+            for name, v in aux_up.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = v
+            self._last_train_args = (diff, nondiff)
+            self._pending_grads = grads
         else:
             if self._fwd_jit is None:
-                self._fwd_jit = jax.jit(self._pure)
-            outs = self._fwd_jit(arg_vals)
+                self._fwd_jit = jax.jit(
+                    lambda vals: self._pure(vals, False)[0])
+            outs = self._fwd_jit(vals)
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
     def backward(self, out_grads=None):
         """Reference: ``Executor.backward``; accumulates into the bound
-        grad arrays per grad_req."""
-        import jax.numpy as jnp
-        if self._vjp is None:
+        grad arrays per grad_req.  With the default head cotangent the
+        gradients were already produced by the training forward's
+        compiled program; a custom ``out_grads`` recomputes."""
+        if self._last_train_args is None:
             raise MXNetError("backward before forward(is_train=True)")
         if out_grads is None:
-            cts = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+            grads = self._pending_grads
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            cts = [g._data for g in out_grads]
-        (grads,) = self._bwd_jit(self._vjp, tuple(cts))
+            cts = tuple(g._data for g in out_grads)
+            diff, nondiff = self._last_train_args
+            _, _, grads = self._train_jit(diff, nondiff, cts)
         for name, g in grads.items():
             req = self.grad_req.get(name, "null")
             if req == "null" or name not in self.grad_dict:
@@ -107,7 +142,8 @@ class Executor:
                 tgt._data = tgt._data + g
             else:
                 tgt._data = g
-        self._vjp = None
+        self._last_train_args = None
+        self._pending_grads = None
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -49,6 +49,11 @@ class EvalMetric:
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    def reset_local(self):
+        """Reset the rolling window (reference keeps global vs local
+        stats; here the two coincide)."""
+        self.reset()
+
     def update(self, labels, preds):
         raise NotImplementedError
 

@@ -1,0 +1,54 @@
+"""Checkpoint conventions (reference: ``python/mxnet/model.py ::
+save_checkpoint/load_checkpoint`` and ``BatchEndParam``).
+
+The on-disk convention is the reference's: ``prefix-symbol.json`` holds
+the graph, ``prefix-%04d.params`` holds a single dict with keys
+``arg:<name>`` / ``aux:<name>`` in the ``.params`` binary format
+(``ndarray.save``), so checkpoints interoperate at the file level.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from . import ndarray as nd
+from . import symbol as sym
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save graph + parameters for ``epoch`` (reference:
+    ``model.py :: save_checkpoint``)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in (arg_params or {}).items()}
+    save_dict.update({("aux:%s" % k): v
+                      for k, v in (aux_params or {}).items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    return param_name
+
+
+def load_params(prefix, epoch):
+    """Load just the ``arg:``/``aux:`` dicts of a checkpoint."""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:  # bare key (gluon-style file): treat as arg
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Returns ``(symbol, arg_params, aux_params)`` (reference:
+    ``model.py :: load_checkpoint``)."""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

@@ -1,0 +1,6 @@
+"""Legacy Module API (reference: ``python/mxnet/module/``)."""
+from .base_module import BaseModule
+from .bucketing_module import BucketingModule
+from .module import Module
+
+__all__ = ["BaseModule", "BucketingModule", "Module"]

@@ -1,0 +1,177 @@
+"""BucketingModule: variable-length training via per-bucket executors
+(reference: ``python/mxnet/module/bucketing_module.py``).
+
+TPU-native framing: a bucket is a static shape class; each bucket gets
+its own jitted Executor (one XLA program per bucket, compiled once,
+cached thereafter) while all buckets share the same parameter arrays --
+the same idea as Gluon hybridize's shape-keyed jit cache, surfaced
+through the legacy API.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from ..initializer import Uniform
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    """Reference: ``BucketingModule(sym_gen, default_bucket_key, ...)``.
+    ``sym_gen(bucket_key) -> (symbol, data_names, label_names)``."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, fixed_param_names=None, state_names=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        return self._sym_gen(self._default_bucket_key)[1]
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        return self._sym_gen(self._default_bucket_key)[0].list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    def _gen_module(self, bucket_key):
+        symbol, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(symbol, data_names=data_names,
+                      label_names=label_names, logger=self.logger,
+                      context=self._context,
+                      fixed_param_names=self._fixed_param_names)
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        """Bind the default bucket (reference: ``BucketingModule.bind``)."""
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        mod = self._gen_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                 force_rebind=False)
+        self._curr_module = mod
+        self._curr_bucket_key = self._default_bucket_key
+        self._buckets[self._default_bucket_key] = mod
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """Compile-or-reuse the executor for ``bucket_key``, sharing
+        parameters with the default bucket (reference:
+        ``switch_bucket``)."""
+        assert self.binded, "call bind before switch_bucket"
+        if bucket_key not in self._buckets:
+            mod = self._gen_module(bucket_key)
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     force_rebind=False)
+            if self.params_initialized:
+                arg, aux = self._buckets[
+                    self._default_bucket_key].get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                allow_missing=False, force_init=True)
+                mod.params_initialized = True
+            if self._curr_module.optimizer_initialized:
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod.optimizer_initialized = True
+            self._buckets[bucket_key] = mod
+        else:
+            mod = self._buckets[bucket_key]
+            if self.params_initialized and self._curr_module is not mod:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                force_init=True)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        assert self.binded
+        if self.params_initialized and not force_init:
+            return
+        self._curr_module.init_params(initializer, arg_params, aux_params,
+                                      allow_missing, force_init, allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def set_params(self, arg_params, aux_params=None, **kwargs):
+        self._curr_module.set_params(arg_params, aux_params, **kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="device", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self._curr_module.init_optimizer(kvstore, optimizer,
+                                         optimizer_params, force_init)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        key = getattr(data_batch, "bucket_key", self._curr_bucket_key)
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        if not self._curr_module.optimizer_initialized:
+            self._curr_module._optimizer = \
+                self._buckets[self._default_bucket_key]._optimizer
+            self._curr_module._updater = \
+                self._buckets[self._default_bucket_key]._updater
+            self._curr_module.optimizer_initialized = True
+        self._curr_module.update()
+        # propagate updated params + aux (BN running stats) back to the
+        # default bucket so newly compiled buckets start from the latest
+        if self._curr_bucket_key != self._default_bucket_key:
+            default = self._buckets[self._default_bucket_key]
+            for name in self._curr_module._param_names:
+                default._exec.arg_dict[name]._data = \
+                    self._curr_module._exec.arg_dict[name]._data
+            for name, arr in self._curr_module._exec.aux_dict.items():
+                if name in default._exec.aux_dict:
+                    default._exec.aux_dict[name]._data = arr._data
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)

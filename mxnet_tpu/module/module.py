@@ -1,0 +1,293 @@
+"""Module: the legacy symbolic training API (reference:
+``python/mxnet/module/module.py :: Module``).
+
+TPU-native design: instead of the reference's
+``DataParallelExecutorGroup`` (one executor per GPU + explicit gradient
+copy/reduce), ONE Executor jits the whole graph and XLA/PJRT handles
+placement; multi-device data parallelism is the ``mxnet_tpu.parallel``
+mesh path, not executor replication.  ``grad_req``/``inputs_need_grad``
+semantics match the reference.
+"""
+from __future__ import annotations
+
+import logging
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..initializer import Uniform, InitDesc
+from ..io.io import DataDesc
+from ..model import load_params, save_checkpoint
+from .base_module import BaseModule, _check_input_names
+
+
+def _normalize_shapes(shapes):
+    """Accept DataDesc, (name, shape) tuples, or dicts."""
+    if shapes is None:
+        return []
+    if isinstance(shapes, dict):
+        shapes = list(shapes.items())
+    out = []
+    for s in shapes:
+        if isinstance(s, DataDesc):
+            out.append(s)
+        else:
+            name, shape = s[0], tuple(s[1])
+            out.append(DataDesc(name, shape))
+    return out
+
+
+class Module(BaseModule):
+    """Reference: ``Module(symbol, data_names, label_names, context)``."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._context = context
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        _check_input_names(symbol, self._data_names, "data", True)
+        _check_input_names(symbol, self._label_names, "label", False)
+
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._inputs_need_grad = False
+        self._input_grads = None
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        shapes = {d.name: d.shape for d in self._data_shapes +
+                  (self._label_shapes or [])}
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self.output_names, out_shapes))
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        """Allocate the executor for the given input shapes (reference:
+        ``Module.bind``).  Weight shapes come from graph shape inference
+        (`Symbol.infer_shape`)."""
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self._data_shapes = _normalize_shapes(data_shapes)
+        self._label_shapes = _normalize_shapes(label_shapes)
+
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        shapes.update({d.name: d.shape for d in self._label_shapes})
+        if not for_training:
+            grad_req = "null"
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._fixed_param_names:
+                req[name] = "null"
+            elif name in self._label_names:
+                req[name] = "null"
+            elif name in self._data_names:
+                req[name] = grad_req if inputs_need_grad else "null"
+            else:
+                req[name] = grad_req
+
+        arg_names = self._symbol.list_arguments()
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        args = {n: nd.zeros(s, ctx=self._context)
+                for n, s in zip(arg_names, arg_shapes)}
+        args_grad = {n: nd.zeros(args[n].shape, ctx=self._context)
+                     for n in arg_names if req[n] != "null"}
+        aux_states = {n: nd.zeros(s, ctx=self._context)
+                      for n, s in zip(self._aux_names, aux_shapes)}
+        from ..executor import Executor
+        self._exec = Executor(self._symbol, self._context, args, args_grad,
+                              req, aux_states=aux_states)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg_p, aux_p = shared_module.get_params()
+            self.set_params(arg_p, aux_p)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """Reference: ``Module.init_params`` -- explicit dicts win,
+        otherwise the Initializer runs with the parameter's InitDesc."""
+        assert self.binded, "call bind before init_params"
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and hasattr(self, "_preloaded_params"):
+            arg_params, preloaded_aux = self._preloaded_params
+            aux_params = aux_params or preloaded_aux
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._data = arg_params[name]._data
+            elif arg_params is not None and not allow_missing:
+                raise MXNetError("missing parameter %r (pass "
+                                 "allow_missing=True to initialize it)"
+                                 % name)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        for name, arr in self._exec.aux_dict.items():
+            if aux_params is not None and name in aux_params:
+                arr._data = aux_params[name]._data
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: v.copy() for n, v in self._exec.aux_dict.items()}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    def init_optimizer(self, kvstore="device", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Reference: ``Module.init_optimizer``.  TPU note: there is one
+        logical parameter copy (XLA owns placement), so the
+        update-on-kvstore split collapses -- the Updater runs directly."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            # reference behavior: Module normalizes gradients by the
+            # batch size via optimizer.rescale_grad
+            if "rescale_grad" not in optimizer_params and self._data_shapes:
+                optimizer_params["rescale_grad"] = \
+                    1.0 / self._data_shapes[0].shape[0]
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+        if getattr(self, "_preloaded_states", None):
+            self.load_optimizer_states(self._preloaded_states)
+            self._preloaded_states = None
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        def to_ctx(arr):
+            # batches arrive on the iterator's (host) context; executors
+            # run where the module was bound (reference: executor-group
+            # slice-and-copy semantics)
+            if self._context is not None and arr.context != self._context:
+                return arr.as_in_context(self._context)
+            return arr
+
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = to_ctx(arr)
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = to_ctx(arr)
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply one optimizer step to every parameter (reference:
+        ``Module.update``)."""
+        assert self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            if name not in self._exec.grad_dict:
+                continue
+            self._updater(i, self._exec.grad_dict[name],
+                          self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self._inputs_need_grad
+        return [self._exec.grad_dict[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Reference: ``Module.save_checkpoint`` -- ``prefix-symbol.json``
+        + ``prefix-%04d.params`` (+ ``.states``)."""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            assert self.optimizer_initialized
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states(dump_optimizer=True))
+
+    def load_optimizer_states(self, fname):
+        """Reference: ``Module.load_optimizer_states``."""
+        assert self.optimizer_initialized
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+        self._optimizer = self._updater.optimizer
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Reference: ``Module.load``.  Parameters apply at
+        ``init_params``; optimizer states (if requested) apply at
+        ``init_optimizer``."""
+        from .. import symbol as sym
+        symbol = sym.load("%s-symbol.json" % prefix)
+        mod = Module(symbol, **kwargs)
+        arg_params, aux_params = load_params(prefix, epoch)
+        mod._preloaded_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            mod._preloaded_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def init_params_from_load(self):
+        arg_params, aux_params = getattr(self, "_preloaded_params",
+                                         (None, None))
+        self.init_params(arg_params=arg_params, aux_params=aux_params)

@@ -351,23 +351,28 @@ def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
     return prob
 
 
-@jax.custom_vjp
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
 def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
                          multi_output, normalization_code):
+    # flags are static (nondiff_argnums): they steer Python control flow
+    # and must not be abstracted by custom_vjp tracing
     return _softmax_output_fwd(data, label, grad_scale, ignore_label,
                                use_ignore, multi_output, normalization_code)
 
 
-def _softmax_output_core_fwd(data, label, grad_scale, ignore_label, use_ignore,
-                             multi_output, normalization_code):
+def _softmax_output_core_fwd(data, label, grad_scale, ignore_label,
+                             use_ignore, multi_output, normalization_code):
     prob = _softmax_output_fwd(data, label, grad_scale, ignore_label,
                                use_ignore, multi_output, normalization_code)
-    return prob, (prob, label, grad_scale, ignore_label, use_ignore,
-                  multi_output, normalization_code)
+    return prob, (prob, label)
 
 
-def _softmax_output_core_bwd(res, g):
-    prob, label, grad_scale, ignore_label, use_ignore, multi_output, norm_code = res
+def _softmax_output_core_bwd(grad_scale, ignore_label, use_ignore,
+                             multi_output, norm_code, res, g):
+    prob, label = res
     # The defining property of SoftmaxOutput (reference:
     # src/operator/softmax_output.cc): backward ignores the incoming
     # cotangent and emits (prob - one_hot(label)) * grad_scale.
@@ -389,7 +394,7 @@ def _softmax_output_core_bwd(res, g):
         else:
             valid = label.size
         grad = grad / valid
-    return (grad * grad_scale, jnp.zeros_like(label), None, None, None, None, None)
+    return (grad * grad_scale, jnp.zeros_like(label))
 
 
 _softmax_output_core.defvjp(_softmax_output_core_fwd, _softmax_output_core_bwd)

@@ -129,8 +129,11 @@ class Symbol:
         return order
 
     def list_arguments(self):
-        """Variable names in topo order (reference: ``list_arguments``)."""
-        return [n.name for n in self._topo() if n.op is None]
+        """Variable names in topo order (reference: ``list_arguments``).
+        Aux-state variables (``__aux__`` attr, e.g. BatchNorm running
+        stats) are excluded, as in the reference."""
+        return [n.name for n in self._topo()
+                if n.op is None and "__aux__" not in n.attrs]
 
     def list_outputs(self):
         out = []
@@ -142,7 +145,11 @@ class Symbol:
         return out
 
     def list_auxiliary_states(self):
-        return []
+        """Aux-state variable names (reference:
+        ``list_auxiliary_states``): mutable non-gradient inputs such as
+        BatchNorm moving_mean/moving_var."""
+        return [n.name for n in self._topo()
+                if n.op is None and "__aux__" in n.attrs]
 
     def get_internals(self):
         nodes = self._topo()
@@ -153,20 +160,21 @@ class Symbol:
 
     # -- shape/type inference -----------------------------------------
     def infer_shape(self, **kwargs):
-        """Reference: ``infer_shape`` (nnvm InferShape pass) -- here via
-        jax.eval_shape over the graph."""
-        import jax
-        arg_names = self.list_arguments()
-        known = {k: tuple(v) for k, v in kwargs.items()}
-        missing = [a for a in arg_names if a not in known]
-        if missing:
-            return None, None, None
-        specs = {a: jax.ShapeDtypeStruct(known[a], np.float32)
-                 for a in arg_names}
-        outs = _eval_symbol_abstract(self, specs)
-        arg_shapes = [known[a] for a in arg_names]
-        out_shapes = [tuple(o.shape) for o in outs]
-        return arg_shapes, out_shapes, []
+        """Reference: ``infer_shape`` (nnvm InferShape pass).
+
+        Forward abstract interpretation: each node is shape-propagated
+        with ``jax.eval_shape``; parameter variables whose shapes are not
+        given are deduced by per-op rules (the analog of each op's
+        FInferShape), so passing only data/label shapes is enough --
+        exactly the contract ``Module.bind`` relies on.
+        """
+        return _infer_shapes_forward(self, kwargs, partial=False)
+
+    def infer_shape_partial(self, **kwargs):
+        """Like ``infer_shape`` but returns ``None`` for undeducible
+        arguments instead of raising (reference:
+        ``infer_shape_partial``)."""
+        return _infer_shapes_forward(self, kwargs, partial=True)
 
     def infer_type(self, **kwargs):
         arg_names = self.list_arguments()
@@ -183,20 +191,26 @@ class Symbol:
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):
         from ..executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req)
+        return Executor(self, ctx, args, args_grad, grad_req,
+                        aux_states=aux_states)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate all arguments and bind (reference: ``simple_bind``).
+        Parameter shapes not passed explicitly are inferred from the
+        data/label shapes via ``infer_shape``."""
         from ..executor import Executor
         from ..ndarray import zeros
-        args = {}
-        for name in self.list_arguments():
-            if name in shapes:
-                args[name] = zeros(shapes[name], ctx=ctx)
-            else:
-                raise MXNetError("simple_bind: missing shape for %r" % name)
+        arg_names = self.list_arguments()
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        args = {name: zeros(shape, ctx=ctx)
+                for name, shape in zip(arg_names, arg_shapes)}
         args_grad = {k: zeros(v.shape, ctx=ctx) for k, v in args.items()} \
             if grad_req != "null" else None
-        return Executor(self, ctx, args, args_grad, grad_req)
+        aux = {name: zeros(shape, ctx=ctx)
+               for name, shape in zip(self.list_auxiliary_states(),
+                                      aux_shapes)}
+        return Executor(self, ctx, args, args_grad, grad_req,
+                        aux_states=aux)
 
     # -- serialization (reference: nnvm saveload_json.cc) -------------
     def tojson(self):
@@ -255,6 +269,28 @@ def _parse_attr_value(v):
         return s
 
 
+# Ops whose extra outputs are secondary (stats, states): composing the
+# whole symbol as an input means "take the primary output", matching the
+# reference's visible-output convention for these ops.
+_PRIMARY_FIRST = {"BatchNorm", "RNN"}
+
+# Aux-state arguments (reference: mutable inputs / aux states): maps the
+# arg name to the output index that carries its updated value, so
+# executors can write running stats back after a training forward.
+_AUX_ARGS = {"BatchNorm": {"moving_mean": 1, "moving_var": 2}}
+
+
+def _skip_auto_var(opname, params, arg_name):
+    """True when a missing tensor arg must NOT be auto-created (it is
+    structurally absent, not an implicit parameter)."""
+    if arg_name == "bias" and params.get("no_bias"):
+        return True
+    if opname == "RNN" and arg_name == "state_cell" \
+            and params.get("mode", "lstm") != "lstm":
+        return True
+    return False
+
+
 def _make_node(opname, input_syms, params, name=None):
     op = get_op(opname)
     hint = opname.lower().lstrip("_")
@@ -265,8 +301,25 @@ def _make_node(opname, input_syms, params, name=None):
             raise MXNetError("op %s: expected Symbol input, got %r"
                              % (opname, s))
         if len(s._outputs) != 1:
+            if s._outputs[0][0].op in _PRIMARY_FIRST:
+                inputs.append(s._outputs[0])
+                continue
             raise MXNetError("op %s: cannot take group symbol" % opname)
         inputs.append(s._outputs[0])
+    # Auto-create variables for omitted tensor args (reference: nnvm
+    # composition creates "{name}_{arg}" vars for missing inputs) so
+    # Module users write `sym.FullyConnected(data, num_hidden=k)` and get
+    # fc_weight/fc_bias arguments implicitly.
+    if not op.variadic and len(inputs) < len(op.arg_names):
+        aux_map = _AUX_ARGS.get(opname, {})
+        for arg_name in op.arg_names[len(inputs):]:
+            if _skip_auto_var(opname, params, arg_name):
+                continue
+            attrs = {}
+            if arg_name in aux_map:
+                attrs["__aux__"] = "1"
+            vnode = _Node(None, "%s_%s" % (name, arg_name), attrs, [])
+            inputs.append((vnode, 0))
     # count outputs via an abstract probe later; store param attrs now
     node = _Node(opname, name, dict(params), inputs)
     node.num_outputs = _probe_num_outputs(op, node)
@@ -287,16 +340,21 @@ def _probe_num_outputs(op, node):
     return 1
 
 
-def _eval_node_value(node, values, op_params_override=None):
-    """Evaluate one node given input values."""
-    from .. import random as _random_mod
-    op = get_op(node.op)
+def _node_params(node, op):
     params = op.param_defaults()
     for k, v in node.attrs.items():
         if k.startswith("__"):
             continue
         if any(p.name == k for p in op.params):
             params[k] = _parse_attr_value(v)
+    return params
+
+
+def _eval_node_value(node, values, op_params_override=None):
+    """Evaluate one node given input values."""
+    from .. import random as _random_mod
+    op = get_op(node.op)
+    params = _node_params(node, op)
     args = [values[(id(src), oi)] for src, oi in node.inputs]
     if not op.variadic and len(args) < len(op.arg_names):
         # optional trailing tensor inputs (e.g. bias with no_bias=True)
@@ -312,8 +370,173 @@ def _eval_node_value(node, values, op_params_override=None):
     return fn(*args, **params)
 
 
-def _eval_symbol(sym, feed):
-    """Execute a symbol graph eagerly against a name->NDArray feed."""
+# ----------------------------------------------------------------------
+# Forward shape inference (nnvm InferShape analog)
+# ----------------------------------------------------------------------
+
+def _as_tuple(v):
+    return (v,) if isinstance(v, int) else tuple(v)
+
+
+def _param_shape_rule(opname, params, arg_name, in_shapes):
+    """Deduce the shape of parameter variable ``arg_name`` of op
+    ``opname`` from the (known) data input shape -- the per-op FInferShape
+    half the Module path needs.  ``in_shapes[0]`` is the data shape.
+    Returns a shape tuple or None if no rule applies."""
+    data = in_shapes[0] if in_shapes and in_shapes[0] is not None else None
+    if data is None:
+        return None
+    if opname == "FullyConnected":
+        nh = int(params.get("num_hidden", 0))
+        if arg_name == "weight":
+            k = int(np.prod(data[1:])) if params.get("flatten", True) \
+                else int(data[-1])
+            return (nh, k)
+        if arg_name == "bias":
+            return (nh,)
+    elif opname == "Convolution":
+        nf = int(params.get("num_filter", 0))
+        kernel = _as_tuple(params.get("kernel", ()))
+        groups = int(params.get("num_group", 1))
+        if arg_name == "weight":
+            return (nf, int(data[1]) // groups) + kernel
+        if arg_name == "bias":
+            return (nf,)
+    elif opname == "Deconvolution":
+        nf = int(params.get("num_filter", 0))
+        kernel = _as_tuple(params.get("kernel", ()))
+        if arg_name == "weight":
+            return (int(data[1]), nf) + kernel
+        if arg_name == "bias":
+            return (nf,)
+    elif opname in ("BatchNorm", "InstanceNorm", "GroupNorm"):
+        axis = int(params.get("axis", 1))
+        return (int(data[axis]),)
+    elif opname == "LayerNorm":
+        axis = int(params.get("axis", -1))
+        return (int(data[axis]),)
+    elif opname == "Embedding":
+        return (int(params.get("input_dim", 0)),
+                int(params.get("output_dim", 0)))
+    elif opname == "_prelu":
+        return (int(data[1]),) if len(data) > 1 else (1,)
+    elif opname in ("SoftmaxOutput", "LogisticRegressionOutput"):
+        if arg_name == "label":
+            return (int(data[0]),)
+    elif opname in ("LinearRegressionOutput", "MAERegressionOutput",
+                    "softmax_cross_entropy"):
+        if arg_name == "label":
+            return tuple(data)
+    return None
+
+
+def _infer_shapes_forward(sym, known, partial=False):
+    """Walk the graph forward, shape-propagating each node with
+    ``jax.eval_shape`` and deducing unknown parameter-variable shapes
+    with `_param_shape_rule`.  Returns (arg_shapes, out_shapes) in
+    ``list_arguments()`` / ``list_outputs()`` order."""
+    import functools
+    import jax
+
+    known = {k: tuple(v) for k, v in known.items()}
+    var_shape = {}          # name -> tuple
+    specs = {}              # (id(node), oi) -> ShapeDtypeStruct
+
+    def var_spec(node):
+        name = node.name
+        if name in known:
+            shape = known[name]
+        elif "__shape__" in node.attrs:
+            shape = tuple(_parse_attr_value(node.attrs["__shape__"]))
+        else:
+            return None
+        var_shape[name] = shape
+        dt = node.attrs.get("__dtype__", "float32")
+        return jax.ShapeDtypeStruct(shape, np.dtype(str(dt)))
+
+    for node in sym._topo():
+        if node.op is None:
+            s = var_spec(node)
+            if s is not None:
+                specs[(id(node), 0)] = s
+            continue
+        op = get_op(node.op)
+        params = _node_params(node, op)
+        in_specs = []
+        in_shapes = [specs.get((id(src), oi)) for src, oi in node.inputs]
+        in_shapes = [tuple(s.shape) if s is not None else None
+                     for s in in_shapes]
+        unresolved = False
+        for i, (src, oi) in enumerate(node.inputs):
+            s = specs.get((id(src), oi))
+            if s is None and src.op is None:
+                shape = _param_shape_rule(node.op, params,
+                                          op.arg_names[i] if i < len(op.arg_names) else "",
+                                          in_shapes)
+                if shape is not None:
+                    s = jax.ShapeDtypeStruct(shape, np.float32)
+                    specs[(id(src), oi)] = s
+                    var_shape[src.name] = shape
+            if s is None:
+                unresolved = True
+            in_specs.append(s)
+        if unresolved:
+            if partial:
+                continue
+            missing = [src.name for (src, oi), s
+                       in zip(node.inputs, in_specs) if s is None]
+            raise MXNetError(
+                "infer_shape: cannot deduce shape(s) of %r feeding op "
+                "%s(%s); pass them explicitly" %
+                (missing, node.op, node.name))
+        nargs = len(in_specs)
+        if not op.variadic and nargs < len(op.arg_names):
+            pad = len(op.arg_names) - nargs
+        else:
+            pad = 0
+
+        fn = op.fcompute
+        if op.stateful_rng:
+            fn = functools.partial(fn, jax.random.PRNGKey(0))
+        if any(p.name == "training" for p in op.params) and \
+                "training" not in node.attrs:
+            params["training"] = False
+        try:
+            out = jax.eval_shape(
+                lambda *a: fn(*(list(a) + [None] * pad), **params),
+                *in_specs)
+        except Exception as e:
+            if partial:
+                continue
+            raise MXNetError("infer_shape failed at %s(%s): %s"
+                             % (node.op, node.name, e))
+        if isinstance(out, (tuple, list)):
+            for i, o in enumerate(out):
+                specs[(id(node), i)] = o
+        else:
+            specs[(id(node), 0)] = out
+
+    arg_names = sym.list_arguments()
+    arg_shapes = [var_shape.get(n) for n in arg_names]
+    if not partial and any(s is None for s in arg_shapes):
+        missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+        raise MXNetError("infer_shape: undetermined arguments %r" % missing)
+    out_shapes = []
+    for n, oi in sym._outputs:
+        s = specs.get((id(n), oi))
+        out_shapes.append(tuple(s.shape) if s is not None else None)
+    aux_shapes = [var_shape.get(n) for n in sym.list_auxiliary_states()]
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def _eval_symbol(sym, feed, aux_updates=None):
+    """Execute a symbol graph eagerly against a name->NDArray feed.
+
+    If ``aux_updates`` is a dict, updated aux-state values (e.g.
+    BatchNorm's new running stats, `_AUX_ARGS`) are collected into it
+    keyed by aux variable name -- executors write them back after a
+    training forward.
+    """
     from ..ndarray import NDArray
     values = {}
     for node in sym._topo():
@@ -329,24 +552,17 @@ def _eval_symbol(sym, feed):
                     values[(id(node), i)] = o
             else:
                 values[(id(node), 0)] = out
+            if aux_updates is not None and node.op in _AUX_ARGS:
+                op = get_op(node.op)
+                for arg_name, out_idx in _AUX_ARGS[node.op].items():
+                    pos = op.arg_names.index(arg_name)
+                    if pos < len(node.inputs):
+                        src, _ = node.inputs[pos]
+                        if src.op is None and \
+                                (id(node), out_idx) in values:
+                            aux_updates[src.name] = \
+                                values[(id(node), out_idx)]
     return [NDArray(values[(id(n), oi)]) for n, oi in sym._outputs]
-
-
-def _eval_symbol_abstract(sym, specs):
-    import jax
-
-    names = sym.list_arguments()
-
-    def fn(vals):
-        feed = {n: _FakeND(vals[n]) for n in names}
-        outs = _eval_symbol(sym, feed)
-        return [o._data for o in outs]
-
-    class _FakeND:
-        def __init__(self, data):
-            self._data = data
-
-    return jax.eval_shape(fn, {n: specs[n] for n in names})
 
 
 def load_json(json_str):

@@ -1,0 +1,190 @@
+"""Module API tests (reference: ``tests/python/unittest/test_module.py``)."""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp_symbol(num_hidden=32, num_classes=4):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_iter(n=64, dim=8, num_classes=4, batch_size=16, seed=0):
+    centers = np.random.RandomState(42).randn(num_classes, dim) * 3
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, num_classes, size=n)
+    x = centers[y] + rng.randn(n, dim) * 0.3
+    return mx.io.NDArrayIter(x.astype(np.float32),
+                             y.astype(np.float32), batch_size,
+                             shuffle=True)
+
+
+def test_infer_shape_deduces_weights():
+    s = _mlp_symbol(num_hidden=32, num_classes=4)
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(16, 8))
+    shapes = dict(zip(s.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (32, 8)
+    assert shapes["fc1_bias"] == (32,)
+    assert shapes["fc2_weight"] == (4, 32)
+    assert shapes["softmax_label"] == (16,)
+    assert out_shapes == [(16, 4)]
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    c = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                        name="conv0")
+    b = sym.BatchNorm(c, name="bn0")
+    arg_shapes, out_shapes, _ = b.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(b.list_arguments(), arg_shapes))
+    assert shapes["conv0_weight"] == (8, 3, 3, 3)
+    assert shapes["bn0_gamma"] == (8,)
+    assert out_shapes[0] == (2, 8, 8, 8)
+
+
+def test_infer_shape_partial():
+    s = _mlp_symbol()
+    arg_shapes, _, _ = s.infer_shape_partial()
+    # nothing known -> every shape None, no raise
+    assert all(a is None for a in arg_shapes)
+
+
+def test_module_fit_mnist_style():
+    """An end-to-end Module.fit run must drive training accuracy well
+    above chance (reference: ``test_module.py :: test_module_fit``)."""
+    train = _toy_iter()
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(16, 2))
+    metric = mx.metric.Accuracy()
+    mod.score(_toy_iter(seed=1), metric)
+    assert metric.get()[1] > 0.8, metric.get()
+
+
+def test_module_forward_backward_update():
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.randn(16, 8).astype(np.float32))],
+        label=[mx.nd.array(np.random.randint(0, 4, 16).astype(np.float32))])
+    before, _ = mod.get_params()
+    mod.forward(batch, is_train=True)
+    out = mod.get_outputs()[0]
+    assert out.shape == (16, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(16), rtol=1e-5)
+    mod.backward()
+    mod.update()
+    after, _ = mod.get_params()
+    assert not np.allclose(before["fc1_weight"].asnumpy(),
+                           after["fc1_weight"].asnumpy())
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    prefix = str(tmp_path / "mlp")
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))])
+    mod.init_params()
+    mod.save_checkpoint(prefix, 3)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0003.params")
+
+    loaded = mx.mod.Module.load(prefix, 3)
+    loaded.bind(data_shapes=[("data", (4, 8))])
+    loaded.init_params()
+    a0, _ = mod.get_params()
+    a1, _ = loaded.get_params()
+    for k in a0:
+        np.testing.assert_allclose(a0[k].asnumpy(), a1[k].asnumpy())
+
+    # the model.py free functions agree
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    assert set(arg_params) == set(a0)
+
+
+def test_module_optimizer_state_resume(tmp_path):
+    """save_optimizer_states=True + Module.load(load_optimizer_states=True)
+    must restore momentum buffers (reference: ``Module.load``)."""
+    prefix = str(tmp_path / "resume")
+    train = _toy_iter(n=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    states0 = mod._updater.states
+
+    loaded = mx.mod.Module.load(prefix, 2, load_optimizer_states=True)
+    loaded.bind(data_shapes=[("data", (16, 8))],
+                label_shapes=[("softmax_label", (16,))])
+    loaded.init_params()
+    loaded.init_optimizer(optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1,
+                                            "momentum": 0.9})
+    assert set(loaded._updater.states) == set(states0)
+    for k, v in states0.items():
+        np.testing.assert_allclose(loaded._updater.states[k].asnumpy(),
+                                   v.asnumpy(), rtol=1e-6)
+
+
+def test_do_checkpoint_callback(tmp_path):
+    prefix = str(tmp_path / "cb")
+    train = _toy_iter(n=32)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train, num_epoch=2,
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert sorted(os.path.basename(p)
+                  for p in glob.glob(prefix + "-*.params")) == \
+        ["cb-0001.params", "cb-0002.params"]
+
+
+def test_bucketing_module():
+    """Per-bucket executors share parameters (reference:
+    ``test_module.py :: test_bucket_module``) -- the TPU shape-class
+    answer to variable-length batches."""
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, num_hidden=8, name="fc_shared",
+                                flatten=False)
+        pooled = sym.mean(fc, axis=1)
+        out = sym.FullyConnected(pooled, num_hidden=2, name="out")
+        return sym.SoftmaxOutput(out, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    for seq_len in (10, 5, 10, 7):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(
+                np.random.randn(4, seq_len, 6).astype(np.float32))],
+            label=[mx.nd.array(np.zeros(4, dtype=np.float32))],
+            provide_data=[mx.io.DataDesc("data", (4, seq_len, 6))],
+            provide_label=[mx.io.DataDesc("softmax_label", (4,))])
+        batch.bucket_key = seq_len
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        assert mod.get_outputs()[0].shape == (4, 2)
+    # shared parameter must be consistent across buckets after updates
+    w_cur = mod._buckets[7]._exec.arg_dict["fc_shared_weight"]
+    w_def = mod._buckets[10]._exec.arg_dict["fc_shared_weight"]
+    np.testing.assert_allclose(w_cur.asnumpy(), w_def.asnumpy())
