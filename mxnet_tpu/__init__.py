@@ -62,4 +62,6 @@ from . import model
 from . import callback
 from . import module
 from . import module as mod
+from . import profiler
+from . import runtime
 from . import test_utils

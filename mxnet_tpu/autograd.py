@@ -150,8 +150,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     grad_req semantics ('write'/'add'/'null') per
     ``include/mxnet/op_attr_types.h :: OpReqType``.
     """
+    import jax
     import jax.numpy as jnp
     from .ndarray import NDArray
+
+    def _ones_on(data):
+        # seed cotangents ON the head's device, COMMITTED: an
+        # uncommitted seed lets linear-op transposes (sum/broadcast take
+        # only the cotangent) run on the default device, which may be a
+        # remote TPU -- one tunnel round-trip per backward node
+        devs = data.devices()
+        if len(devs) == 1:
+            dev = next(iter(devs))
+            with jax.default_device(dev):
+                return jax.device_put(jnp.ones(data.shape, data.dtype),
+                                      dev)
+        return jnp.ones_like(data)
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -180,14 +194,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         if node is None:
             if getattr(h, "_grad", None) is not None:
                 # head is itself a leaf: d head / d head = 1
-                g = jnp.ones_like(h._data) if hg is None else hg._data
+                g = _ones_on(h._data) if hg is None else hg._data
                 _to_leaf(h, g)
                 continue
             raise MXNetError(
                 "cannot differentiate: array is not part of a recorded "
                 "computation (call inside autograd.record())")
         idx = h._ag_out_index
-        g = jnp.ones_like(h._data) if hg is None else hg._data
+        g = _ones_on(h._data) if hg is None else hg._data
         node.out_grads[idx] = g if node.out_grads[idx] is None \
             else node.out_grads[idx] + g
 
@@ -198,8 +212,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             raise MXNetError(
                 "backward through a graph that was already freed; pass "
                 "retain_graph=True to backward() to allow repeated calls")
+        dev = next((next(iter(g.devices())) for g in node.out_grads
+                    if g is not None and len(g.devices()) == 1), None)
+
+        def _zeros(shp, dt):
+            if dev is not None:
+                with jax.default_device(dev):
+                    return jax.device_put(jnp.zeros(shp, dt), dev)
+            return jnp.zeros(shp, dt)
+
         cts = tuple(
-            g if g is not None else jnp.zeros(shp, dt)
+            g if g is not None else _zeros(shp, dt)
             for g, (shp, dt) in zip(node.out_grads, node._out_avals))
         in_cts = node.vjp_fn(cts if node.num_outputs > 1 else cts[0])
         if not isinstance(in_cts, (tuple, list)):

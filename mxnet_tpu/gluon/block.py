@@ -438,7 +438,12 @@ class HybridBlock(Block):
         if entry is None:
             entry = self._build_cache(args, training)
             self._cached_entries[key] = entry
-        return self._run_cached(entry, args, recording)
+        import contextlib
+        from .. import profiler as _profiler
+        scope = _profiler.scope("mx.cachedop:%s" % type(self).__name__) \
+            if _profiler._scopes_enabled else contextlib.nullcontext()
+        with scope:
+            return self._run_cached(entry, args, recording)
 
     def _build_cache(self, args, training):
         """Trace the imperative forward into a pure jax function and jit it

@@ -16,7 +16,9 @@ from the reference, documented here.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
+import os
 import struct
 
 import numpy as np
@@ -258,10 +260,22 @@ class NDArray:
 
     # -- arithmetic (rebinding in-place forms) ------------------------
     def _binop(self, other, opname, reverse=False):
+        if not isinstance(other, NDArray) and np.isscalar(other):
+            # scalar operand -> dedicated *_scalar op (reference
+            # semantics); keeps the scalar a compile-time param instead
+            # of a per-call host->device transfer
+            sop = _SCALAR_OP.get((opname, reverse))
+            if sop is not None:
+                return invoke(get_op(sop), [self],
+                              {"scalar": float(other)})
         if isinstance(other, NDArray):
             rhs = other
-        else:
+        elif _is_traced(self._data) or len(self._data.devices()) != 1:
             rhs = NDArray(jnp.asarray(other, dtype=self._data.dtype))
+        else:
+            arr = np.asarray(other, dtype=self._data.dtype)
+            rhs = NDArray(jax.device_put(
+                arr, next(iter(self._data.devices()))))
         lhs = self
         if reverse:
             lhs, rhs = rhs, lhs
@@ -514,6 +528,112 @@ def _wrap_outputs(op, raw, inputs_for_tape, vjp_fn, params):
     return outs if multi else outs[0]
 
 
+# scalar-operand op table for NDArray._binop (reference: the
+# ``_plus_scalar``-family ops backing ndarray's operator overloads)
+_SCALAR_OP = {
+    ("elemwise_add", False): "_plus_scalar",
+    ("elemwise_add", True): "_plus_scalar",
+    ("elemwise_sub", False): "_minus_scalar",
+    ("elemwise_sub", True): "_rminus_scalar",
+    ("elemwise_mul", False): "_mul_scalar",
+    ("elemwise_mul", True): "_mul_scalar",
+    ("elemwise_div", False): "_div_scalar",
+    ("elemwise_div", True): "_rdiv_scalar",
+    ("broadcast_power", False): "_power_scalar",
+    ("broadcast_power", True): "_rpower_scalar",
+    ("broadcast_mod", False): "_mod_scalar",
+    ("broadcast_equal", False): "_equal_scalar",
+    ("broadcast_equal", True): "_equal_scalar",
+    ("broadcast_not_equal", False): "_not_equal_scalar",
+    ("broadcast_not_equal", True): "_not_equal_scalar",
+    ("broadcast_greater", False): "_greater_scalar",
+    ("broadcast_greater", True): "_lesser_scalar",
+    ("broadcast_greater_equal", False): "_greater_equal_scalar",
+    ("broadcast_greater_equal", True): "_lesser_equal_scalar",
+    ("broadcast_lesser", False): "_lesser_scalar",
+    ("broadcast_lesser", True): "_greater_scalar",
+    ("broadcast_lesser_equal", False): "_lesser_equal_scalar",
+    ("broadcast_lesser_equal", True): "_greater_equal_scalar",
+}
+
+
+# ----------------------------------------------------------------------
+# Eager dispatch jit cache (SURVEY §7 hard-part #1): every imperative op
+# call runs through a persistent compiled primitive keyed on
+# (op, arg shapes/dtypes, params, amp policy), so non-hybridized training
+# pays one XLA executable launch instead of tens of µs of Python+trace
+# per op.  The reference's analog is the engine's cached fcompute path.
+# ----------------------------------------------------------------------
+_EAGER_JIT_CACHE = {}
+_EAGER_JIT_ENABLED = os.environ.get("MXNET_TPU_EAGER_JIT", "1") != "0"
+
+
+def _canon_param(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon_param(x) for x in v)
+    if isinstance(v, np.ndarray):
+        return ("__np__", v.shape, str(v.dtype), v.tobytes())
+    return v
+
+
+# Float params that vary per call (per-step lr/wd schedules, arbitrary
+# `x + c` scalars): traced as weak-typed jit arguments so a new VALUE
+# does not mean a new XLA compilation.  Everything else (flags, shapes,
+# clip thresholds with Python control flow) stays static in the key.
+_DYNAMIC_PARAMS = frozenset(("lr", "wd", "rescale_grad", "scalar"))
+
+
+def _eager_jit_fn(op, params, present, total_args):
+    """Return ``(jfn, dyn_names)`` -- a cached jitted callable plus the
+    names of params it takes as traced scalars -- or ``(None, ())`` when
+    the call is unjittable (unhashable params)."""
+    if not _EAGER_JIT_ENABLED:
+        return None, ()
+    dyn_names = tuple(sorted(
+        k for k in params
+        if k in _DYNAMIC_PARAMS and isinstance(params[k], (int, float))
+        and not isinstance(params[k], bool)))
+    try:
+        psig = tuple(sorted((k, _canon_param(v))
+                            for k, v in params.items()
+                            if k not in dyn_names))
+        hash(psig)
+    except TypeError:
+        return None, ()
+    from .. import amp as _amp
+    amp_token = _amp.policy_token() if _amp_active() else None
+    sig = (op.name, present, total_args, psig, dyn_names, amp_token)
+    jfn = _EAGER_JIT_CACHE.get(sig)
+    if jfn is None:
+        fcompute = op.fcompute
+        stateful = op.stateful_rng
+        opname = op.name
+        static_kwargs = {k: v for k, v in params.items()
+                         if k not in dyn_names}
+        do_amp = amp_token is not None
+
+        def f(dyn_vals, *pd):
+            if stateful:
+                rng_key, pd = pd[0], pd[1:]
+            full = [None] * total_args
+            for i, d in zip(present, pd):
+                full[i] = d
+            if do_amp:
+                from .. import amp as _amp2
+                # casts INSIDE the differentiated function: the cast vjp
+                # returns fp32 gradients (master weights for free)
+                full = _amp2.apply_op_casts(opname, full)
+            kwargs = dict(static_kwargs)
+            kwargs.update(zip(dyn_names, dyn_vals))
+            if stateful:
+                return fcompute(rng_key, *full, **kwargs)
+            return fcompute(*full, **kwargs)
+
+        jfn = jax.jit(f)
+        _EAGER_JIT_CACHE[sig] = jfn
+    return jfn, dyn_names
+
+
 def invoke(op: Op, tensor_args, kwargs, out=None):
     """Dispatch one op eagerly (reference: ``Imperative::Invoke`` in
     ``src/imperative/imperative.cc``; shape/type inference + engine push
@@ -528,6 +648,13 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
     if any(p.name == "training" for p in op.params) and "training" not in kwargs:
         params["training"] = autograd.is_training()
 
+    # single-device reference only: committing a converted operand to
+    # one device of a SHARDED operand's set would break the jit call
+    ref_device = next((next(iter(a._data.devices()))
+                       for a in tensor_args
+                       if isinstance(a, NDArray)
+                       and not _is_traced(a._data)
+                       and len(a._data.devices()) == 1), None)
     nds = []
     datas = []
     for a in tensor_args:
@@ -538,38 +665,52 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
             nds.append(a)
             datas.append(a._data)
         else:
-            nd = NDArray(jnp.asarray(a))
+            # place converted operands WITH the tensor operands -- the
+            # default device may be a remote TPU, and a stray transfer
+            # per op call is a tunnel round-trip
+            raw = np.asarray(a)
+            nd = NDArray(jax.device_put(raw, ref_device)
+                         if ref_device is not None else jnp.asarray(raw))
             nds.append(nd)
             datas.append(nd._data)
 
-    fn = op.fcompute
-    if op.stateful_rng:
-        key = _random_mod.next_key()
-        fn = functools.partial(fn, key)
+    key = _random_mod.next_key() if op.stateful_rng else None
 
-    present = [i for i, d in enumerate(datas) if d is not None]
+    present = tuple(i for i, d in enumerate(datas) if d is not None)
     pdatas = [datas[i] for i in present]
 
-    def call(*pd):
-        full = list(datas)
-        for i, d in zip(present, pd):
-            full[i] = d
-        if _amp_active():
-            # AMP casts go INSIDE the differentiated function so the cast's
-            # vjp returns fp32 gradients (fp32 master weights for free).
-            from .. import amp as _amp
-            full = _amp.apply_op_casts(op.name, full)
-        return fn(*full, **params)
+    jfn, dyn_names = _eager_jit_fn(op, params, present, len(datas))
+    if jfn is not None:
+        dyn_vals = tuple(float(params[n]) for n in dyn_names)
+        call = functools.partial(jfn, dyn_vals, key) if op.stateful_rng \
+            else functools.partial(jfn, dyn_vals)
+    else:
+        # unjittable params (rare): eager fallback
+        fn = functools.partial(op.fcompute, key) if op.stateful_rng \
+            else op.fcompute
 
+        def call(*pd):
+            full = list(datas)
+            for i, d in zip(present, pd):
+                full[i] = d
+            if _amp_active():
+                from .. import amp as _amp
+                full = _amp.apply_op_casts(op.name, full)
+            return fn(*full, **params)
+
+    from .. import profiler as _profiler
+    scope = _profiler.scope("mx." + op.name) \
+        if _profiler._scopes_enabled else contextlib.nullcontext()
     recording = autograd.is_recording() and any(
         n is not None and n._is_tracked() for n in nds)
-    if recording:
-        raw, vjp_fn = jax.vjp(call, *pdatas)
-        tape_inputs = [nds[i] for i in present]
-        result = _wrap_outputs(op, raw, tape_inputs, vjp_fn, params)
-    else:
-        raw = call(*pdatas)
-        result = _wrap_outputs(op, raw, None, None, params)
+    with scope:
+        if recording:
+            raw, vjp_fn = jax.vjp(call, *pdatas)
+            tape_inputs = [nds[i] for i in present]
+            result = _wrap_outputs(op, raw, tape_inputs, vjp_fn, params)
+        else:
+            raw = call(*pdatas)
+            result = _wrap_outputs(op, raw, None, None, params)
 
     if out is not None:
         src = result if not isinstance(result, list) else result[0]

@@ -129,60 +129,96 @@ def _clip(data, a_min=0.0, a_max=1.0):
     return jnp.clip(data, a_min, a_max)
 
 
-# scalar forms (reference: elemwise_binary_scalar_op*.cc)
+# scalar forms (reference: elemwise_binary_scalar_op*.cc).  The scalar
+# operand adopts the array's dtype (reference semantics: int arrays stay
+# int; a bf16 array is not promoted by a Python float).
+def _sc(data, scalar):
+    return jnp.asarray(scalar).astype(data.dtype)
+
+
 @register("_plus_scalar", args=("data",))
 def _plus_scalar(data, scalar=0.0):
-    return data + scalar
+    return data + _sc(data, scalar)
 
 
 @register("_minus_scalar", args=("data",))
 def _minus_scalar(data, scalar=0.0):
-    return data - scalar
+    return data - _sc(data, scalar)
 
 
 @register("_rminus_scalar", args=("data",))
 def _rminus_scalar(data, scalar=0.0):
-    return scalar - data
+    return _sc(data, scalar) - data
 
 
 @register("_mul_scalar", args=("data",))
 def _mul_scalar(data, scalar=1.0):
-    return data * scalar
+    return data * _sc(data, scalar)
 
 
 @register("_div_scalar", args=("data",))
 def _div_scalar(data, scalar=1.0):
-    return data / scalar
+    return data / _sc(data, scalar)
 
 
 @register("_rdiv_scalar", args=("data",))
 def _rdiv_scalar(data, scalar=1.0):
-    return scalar / data
+    return _sc(data, scalar) / data
 
 
 @register("_power_scalar", args=("data",))
 def _power_scalar(data, scalar=1.0):
-    return data ** scalar
+    return data ** _sc(data, scalar)
 
 
 @register("_rpower_scalar", args=("data",))
 def _rpower_scalar(data, scalar=1.0):
-    return scalar ** data
+    return _sc(data, scalar) ** data
 
 
 @register("_mod_scalar", args=("data",))
 def _mod_scalar(data, scalar=1.0):
-    return jnp.mod(data, scalar)
+    return jnp.mod(data, _sc(data, scalar))
 
 
 @register("_maximum_scalar", args=("data",))
 def _maximum_scalar(data, scalar=0.0):
-    return jnp.maximum(data, scalar)
+    return jnp.maximum(data, _sc(data, scalar))
 
 
 @register("_minimum_scalar", args=("data",))
 def _minimum_scalar(data, scalar=0.0):
-    return jnp.minimum(data, scalar)
+    return jnp.minimum(data, _sc(data, scalar))
+
+
+@register("_equal_scalar", args=("data",))
+def _equal_scalar(data, scalar=0.0):
+    return (data == scalar).astype(data.dtype)
+
+
+@register("_not_equal_scalar", args=("data",))
+def _not_equal_scalar(data, scalar=0.0):
+    return (data != scalar).astype(data.dtype)
+
+
+@register("_greater_scalar", args=("data",))
+def _greater_scalar(data, scalar=0.0):
+    return (data > scalar).astype(data.dtype)
+
+
+@register("_greater_equal_scalar", args=("data",))
+def _greater_equal_scalar(data, scalar=0.0):
+    return (data >= scalar).astype(data.dtype)
+
+
+@register("_lesser_scalar", args=("data",))
+def _lesser_scalar(data, scalar=0.0):
+    return (data < scalar).astype(data.dtype)
+
+
+@register("_lesser_equal_scalar", args=("data",))
+def _lesser_equal_scalar(data, scalar=0.0):
+    return (data <= scalar).astype(data.dtype)
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,131 @@
+"""Profiler (reference: ``python/mxnet/profiler.py`` over
+``src/profiler/profiler.cc``).
+
+TPU-native design: the heavy lifting is ``jax.profiler`` -- XLA already
+records per-op device timelines, HBM usage, and host/device transfer
+events into a TensorBoard-loadable trace, which replaces the reference's
+hand-rolled chrome-tracing writer.  This module supplies the reference's
+control surface (``set_config / set_state / start / stop / dump``) plus
+named scopes that executors and the imperative dispatcher enter so
+framework-level structure (op names, cached-graph steps) shows up in the
+device trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .base import MXNetError
+
+_config = {
+    "filename": "profile.json",   # reference arg; dir is derived from it
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_state = "stop"
+_trace_dir = None
+_scopes_enabled = False
+
+
+def set_config(**kwargs):
+    """Reference: ``profiler.set_config``.  ``filename`` determines the
+    trace output directory (its dirname; traces are TensorBoard format,
+    not a single json)."""
+    unknown = set(kwargs) - set(_config)
+    if unknown:
+        raise MXNetError("profiler.set_config: unknown options %r"
+                         % sorted(unknown))
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Reference: ``profiler.set_state('run'|'stop')``."""
+    global _state, _trace_dir, _scopes_enabled
+    if state not in ("run", "stop"):
+        raise MXNetError("profiler state must be 'run' or 'stop'")
+    if state == "run" and _state == "stop":
+        import jax
+        _trace_dir = os.path.dirname(
+            os.path.abspath(_config["filename"])) or "."
+        _trace_dir = os.path.join(_trace_dir, "mxnet_tpu_profile")
+        os.makedirs(_trace_dir, exist_ok=True)
+        jax.profiler.start_trace(_trace_dir)
+        _scopes_enabled = True
+        _state = "run"
+    elif state == "stop" and _state == "run":
+        import jax
+        jax.profiler.stop_trace()
+        _scopes_enabled = False
+        _state = "stop"
+
+
+def start(profile_process="worker"):
+    """Reference: ``profiler.start``."""
+    set_state("run", profile_process)
+
+
+def stop(profile_process="worker"):
+    """Reference: ``profiler.stop``."""
+    set_state("stop", profile_process)
+
+
+def pause(profile_process="worker"):
+    """Scopes off; device trace keeps running (closest analog)."""
+    global _scopes_enabled
+    _scopes_enabled = False
+
+
+def resume(profile_process="worker"):
+    global _scopes_enabled
+    if _state == "run":
+        _scopes_enabled = True
+
+
+def dump(finished=True, profile_process="worker"):
+    """Reference: ``profiler.dump`` -- finalize the trace to disk.  The
+    trace directory (TensorBoard `plugins/profile` layout) is returned."""
+    if _state == "run" and finished:
+        stop()
+    return _trace_dir
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Reference: ``profiler.dumps`` (aggregate stats).  Aggregation
+    lives in the TensorBoard profile; this returns a pointer string."""
+    return ("profile trace: %s (load with TensorBoard's profile plugin)"
+            % (_trace_dir or "<not started>"))
+
+
+def state():
+    return _state
+
+
+@contextlib.contextmanager
+def scope(name):
+    """Named region; shows up in the XLA device trace (reference:
+    profiler scope in ``MXNET_PROFILER_SCOPE``)."""
+    if not _scopes_enabled:
+        yield
+        return
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class Profiler:
+    """Context manager sugar: ``with mx.profiler.Profiler(filename=...):``"""
+
+    def __init__(self, **config):
+        if config:
+            set_config(**config)
+
+    def __enter__(self):
+        start()
+        return self
+
+    def __exit__(self, *exc):
+        stop()

@@ -1,0 +1,76 @@
+"""Runtime feature detection (reference: ``python/mxnet/runtime.py ::
+Features`` over ``src/libinfo.cc``).
+
+The reference reports compile-time flags (CUDA, MKLDNN, OPENMP, ...).
+Here features are runtime properties of the JAX/XLA substrate: which
+PJRT backends are live, whether a TPU is attached, which optional
+subsystems (Pallas kernels, native recordio) loaded.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    import jax
+
+    def has_backend(name):
+        try:
+            return len(jax.devices(name)) > 0
+        except Exception:
+            return False
+
+    tpu = has_backend("tpu") or has_backend("axon")
+    feats = {
+        "TPU": tpu,
+        "GPU": has_backend("gpu"),
+        "CPU": True,
+        "CUDA": False,          # by design: XLA/PJRT, not CUDA
+        "CUDNN": False,
+        "MKLDNN": False,        # XLA:CPU is the CPU backend
+        "XLA": True,
+        "PALLAS": _try_import("jax.experimental.pallas"),
+        "BF16": True,           # native MXU dtype
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+        "NATIVE_RECORDIO": _try_import("mxnet_tpu._native_check"),
+        "DIST_KVSTORE": True,   # jax.distributed + collectives
+        "OPENMP": False,
+        "F16C": True,
+    }
+    return {k: Feature(k, bool(v)) for k, v in feats.items()}
+
+
+def _try_import(mod):
+    import importlib
+    try:
+        importlib.import_module(mod)
+        return True
+    except Exception:
+        return False
+
+
+class Features(dict):
+    """Reference: ``mx.runtime.Features()`` -- mapping of feature name to
+    Feature(name, enabled) with ``is_enabled``."""
+
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("unknown feature %r" % feature_name)
+        return self[feature_name].enabled
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(
+            "✔ %s" % k if v.enabled else "✖ %s" % k
+            for k, v in sorted(self.items()))
+
+
+def feature_list():
+    """Reference: ``libinfo_features``."""
+    return list(Features().values())

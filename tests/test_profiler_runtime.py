@@ -1,0 +1,105 @@
+"""Profiler + runtime-features + eager-dispatch tests (reference:
+``tests/python/unittest/test_profiler.py`` / ``test_runtime.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_profiler_trace_lifecycle(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "profile.json"))
+    assert mx.profiler.state() == "stop"
+    mx.profiler.start()
+    assert mx.profiler.state() == "run"
+    x = mx.nd.ones((8, 8))
+    (x * 2).asnumpy()
+    trace_dir = mx.profiler.dump()
+    assert mx.profiler.state() == "stop"
+    assert trace_dir and os.path.isdir(trace_dir)
+    # jax writes TensorBoard plugins/profile data under the dir
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found, "no trace files written"
+    assert "profile trace" in mx.profiler.dumps()
+
+
+def test_profiler_bad_config():
+    with pytest.raises(mx.MXNetError):
+        mx.profiler.set_config(bogus_option=1)
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats.is_enabled("CPU")
+    assert not feats.is_enabled("CUDA")
+    assert any(f.name == "TPU" for f in mx.runtime.feature_list())
+    with pytest.raises(RuntimeError):
+        feats.is_enabled("NOT_A_FEATURE")
+
+
+def test_eager_jit_cache_populates_and_reuses():
+    from mxnet_tpu.ndarray import ndarray as ndmod
+    before = len(ndmod._EAGER_JIT_CACHE)
+    x = mx.nd.ones((4, 5))
+    for _ in range(3):
+        y = x * 2.0 + 1.0
+    after = len(ndmod._EAGER_JIT_CACHE)
+    assert after > before          # populated
+    for _ in range(3):
+        y = x * 2.0 + 1.0
+    assert len(ndmod._EAGER_JIT_CACHE) == after   # reused, no growth
+    np.testing.assert_allclose(y.asnumpy(), np.full((4, 5), 3.0))
+
+
+def test_eager_jit_no_recompile_on_varying_float_params():
+    """Per-step lr/wd/scalar values are traced, not baked into the cache
+    key -- Adam-style bias-corrected lr must not compile per step."""
+    from mxnet_tpu.ndarray import ndarray as ndmod
+    w = mx.nd.ones((8,))
+    g = mx.nd.ones((8,))
+    m = mx.nd.zeros((8,))
+    v = mx.nd.zeros((8,))
+    mx.nd.adam_update(w, g, m, v, lr=0.001, out=w)
+    before = set(ndmod._EAGER_JIT_CACHE)
+    for t in range(1, 5):
+        lr = 0.001 * (1 - 0.999 ** t) ** 0.5 / (1 - 0.9 ** t)
+        mx.nd.adam_update(w, g, m, v, lr=lr, out=w)
+        x = mx.nd.ones((4,)) + (0.5 * t)
+    assert set(ndmod._EAGER_JIT_CACHE) - before <= \
+        {("_plus_scalar", (0,), 1, (), ("scalar",), None)}
+
+
+def test_scalar_binop_preserves_int_dtype():
+    x = mx.nd.array(np.array([1, 2, 3]), dtype="int32")
+    y = x + 2
+    assert y.dtype == np.int32
+    np.testing.assert_array_equal(y.asnumpy(), [3, 4, 5])
+    z = x * 3
+    assert z.dtype == np.int32
+
+
+def test_scalar_binops_use_scalar_ops():
+    """Python-scalar operands must not materialize device arrays
+    (they dispatch to the *_scalar op family)."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose((1.0 - x).asnumpy(), 1.0 - np.arange(
+        6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose((2.0 / (x + 1)).asnumpy(),
+                               2.0 / (np.arange(6, dtype=np.float32)
+                                      .reshape(2, 3) + 1))
+    np.testing.assert_allclose((x ** 2).asnumpy(),
+                               np.arange(6, dtype=np.float32)
+                               .reshape(2, 3) ** 2)
+    np.testing.assert_allclose((x > 2.0).asnumpy(),
+                               (np.arange(6).reshape(2, 3) > 2)
+                               .astype(np.float32))
+    np.testing.assert_allclose((3.0 > x).asnumpy(),
+                               (3 > np.arange(6).reshape(2, 3))
+                               .astype(np.float32))
+    np.testing.assert_allclose((x == 2.0).asnumpy(),
+                               (np.arange(6).reshape(2, 3) == 2)
+                               .astype(np.float32))
