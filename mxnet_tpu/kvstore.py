@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 from .ndarray import NDArray
+from .ndarray import sparse as _sp
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
@@ -101,12 +102,23 @@ class KVStore:
             else NDArray(value)
 
     def _merge(self, value):
-        """Sum a list of pushed values (the reference's CommDevice reduce)."""
+        """Sum a list of pushed values (the reference's CommDevice
+        reduce).  All-row-sparse lists merge by row union, staying
+        sparse; any dense operand densifies the sum.  Returns a raw
+        jnp array for dense results, a sparse array otherwise."""
         if isinstance(value, (list, tuple)):
+            if any(isinstance(v, _sp.RowSparseNDArray) for v in value):
+                merged = value[0]
+                for v in value[1:]:
+                    merged = _sp.elemwise_add(merged, v)
+                return merged._data if isinstance(merged, NDArray) \
+                    else merged
             merged = value[0]._data
             for v in value[1:]:
                 merged = merged + v._data
             return merged
+        if isinstance(value, _sp.BaseSparseNDArray):
+            return value
         return value._data
 
     def push(self, key, value, priority=0):
@@ -118,19 +130,37 @@ class KVStore:
         if key not in self._store:
             raise MXNetError("kvstore key %r not initialized" % key)
         merged = self._merge(value)
-        if self._compression is not None:
+        sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
+        if not sparse_grad and self._compression is not None:
             merged = self._compression.compress_decompress(key, merged)
+        if self._is_dist and sparse_grad:
+            # cross-process reduction is dense (row unions differ per
+            # worker; the collective needs a static shape)
+            merged = merged.todense()._data
+            sparse_grad = False
         if self._is_dist:
             merged = _allreduce_across_processes(merged)
         if self._updater is not None:
-            grad = NDArray(merged)
+            grad = merged if sparse_grad else NDArray(merged)
             self._updater(key, grad, self._store[key])
         else:
             pending = getattr(self, "_pending", None)
             if pending is None:
                 self._pending = pending = {}
-            pending[key] = merged if key not in pending \
-                else pending[key] + merged
+            if key not in pending:
+                pending[key] = merged
+            elif sparse_grad or isinstance(pending[key],
+                                           _sp.BaseSparseNDArray):
+                a, b = pending[key], merged
+                a = NDArray(a) if not isinstance(
+                    a, (_sp.BaseSparseNDArray, NDArray)) else a
+                b = NDArray(b) if not isinstance(
+                    b, (_sp.BaseSparseNDArray, NDArray)) else b
+                s = _sp.elemwise_add(a, b)
+                pending[key] = s if isinstance(
+                    s, _sp.BaseSparseNDArray) else s._data
+            else:
+                pending[key] = pending[key] + merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
@@ -143,6 +173,8 @@ class KVStore:
         pending = getattr(self, "_pending", {})
         if self._updater is None and key in pending:
             src = pending.pop(key)
+            if isinstance(src, _sp.BaseSparseNDArray):
+                src = src.todense()._data
         else:
             src = self._store[key]._data
         outs = out if isinstance(out, (list, tuple)) else [out]
@@ -163,17 +195,22 @@ class KVStore:
             return
         key = self._keyify(key)
         merged = self._merge(value)
-        if self._compression is not None:
+        sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
+        if not sparse_grad and self._compression is not None:
             merged = self._compression.compress_decompress(key, merged)
+        if self._is_dist and sparse_grad:
+            merged = merged.todense()._data
+            sparse_grad = False
         if self._is_dist:
             merged = _allreduce_across_processes(merged)
         if self._updater is not None:
             if key not in self._store:
                 raise MXNetError("kvstore key %r not initialized" % key)
-            self._updater(key, NDArray(merged), self._store[key])
+            grad = merged if sparse_grad else NDArray(merged)
+            self._updater(key, grad, self._store[key])
             result = self._store[key]._data
         else:
-            result = merged
+            result = merged.todense()._data if sparse_grad else merged
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
@@ -181,20 +218,32 @@ class KVStore:
         return out
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull selected rows (reference: ``PullRowSparse``).  Dense
-        storage: gathers the requested rows."""
+        """Pull ONLY the requested rows (reference: ``PullRowSparse``).
+        Moves k rows, not the full table: the embedding-scale win the
+        row-sparse type exists for.  ``out`` may be a RowSparseNDArray
+        (filled sparsely) or a dense NDArray (rows scattered, rest 0);
+        with ``out=None`` a RowSparseNDArray is returned."""
         key = self._keyify(key)
         if key not in self._store:
             raise MXNetError("kvstore key %r not initialized" % key)
         if row_ids is None:
             return self.pull(key, out, priority)
-        rows = row_ids._data.astype(jnp.int32) if isinstance(row_ids, NDArray) \
-            else jnp.asarray(row_ids, jnp.int32)
+        rows = row_ids._data if isinstance(row_ids, NDArray) else row_ids
+        # dedup host-side (reference PullRowSparse dedups): duplicate ids
+        # would double rows under the sparse todense() scatter-add
+        rows = jnp.asarray(np.unique(np.asarray(rows).astype(np.int32)))
         full = self._store[key]._data
-        picked = jnp.zeros_like(full).at[rows].set(full[rows])
+        picked_rows = full[rows]                      # (k, ...) gather only
+        if out is None:
+            return _sp.RowSparseNDArray(picked_rows, rows,
+                                        full.shape, full.dtype)
         outs = out if isinstance(out, (list, tuple)) else [out]
         for o in outs:
-            o._data = picked
+            if isinstance(o, _sp.RowSparseNDArray):
+                o._rs_data = picked_rows
+                o._rs_indices = rows
+            else:
+                o._data = jnp.zeros_like(full).at[rows].set(picked_rows)
         return out
 
     # -- optimizer on the store (reference: server-side optimizer) -----

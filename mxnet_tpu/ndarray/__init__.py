@@ -6,6 +6,7 @@ from .ndarray import (NDArray, array, arange, concat, concatenate, empty,
                       onehot_encode, save, waitall, zeros)
 from . import register as _register
 from . import random  # noqa: F401
+from . import sparse  # noqa: F401
 
 _register.populate(_sys.modules[__name__].__dict__)
 

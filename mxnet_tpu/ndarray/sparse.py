@@ -1,0 +1,336 @@
+"""Sparse NDArrays: CSR and row-sparse storage (reference:
+``python/mxnet/ndarray/sparse.py :: CSRNDArray, RowSparseNDArray`` over
+``src/ndarray/ndarray.cc`` kCSRStorage/kRowSparseStorage).
+
+TPU-first design note.  XLA wants static shapes and dense tiles; truly
+dynamic sparsity patterns defeat the MXU.  So sparse here is primarily a
+**storage and communication** format -- embedding-gradient rows riding
+the kvstore (``row_sparse_pull`` moves K rows, not the full table),
+lazy/sparse optimizer updates touching only live rows, CSR datasets fed
+batch-dense to the chip -- while *compute* lowers to dense-tiled
+gather/scatter/segment ops with static output shapes (`jnp.take`,
+``.at[].add``, ``jax.ops.segment_sum``).  That matches how the reference
+uses these types in its headline workloads (sparse embeddings, libsvm
+input), without fighting the hardware.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "zeros", "array", "dot", "retain",
+           "add", "elemwise_add"]
+
+
+def _dev(ctx):
+    return (ctx if ctx is not None else current_context()).jax_device()
+
+
+class BaseSparseNDArray:
+    """Common surface of the sparse storage types (reference:
+    ``BaseSparseNDArray``)."""
+
+    stype = None
+
+    def __init__(self, shape, dtype, ctx):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._ctx = ctx
+
+    @property
+    def context(self):
+        return self._ctx
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def asnumpy(self):
+        return np.asarray(self.todense()._data)
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        """Densify (reference: ``tostype('default')``)."""
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        raise MXNetError("cannot convert %s to %s directly"
+                         % (self.stype, stype))
+
+    def copyto(self, other):
+        raise MXNetError("copyto on sparse arrays: densify first "
+                         "(tostype('default'))")
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__,
+                                "x".join(map(str, self.shape)), self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: ``CSRNDArray``).
+
+    Components: ``indptr`` (n_rows+1,), ``indices`` (nnz,), ``data``
+    (nnz,).  nnz is static per array instance -- XLA compiles one
+    program per nnz class, the sparse analog of shape bucketing.
+    """
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None, ctx=None):
+        dtype = dtype or getattr(data, "dtype", np.float32)
+        super().__init__(shape, dtype, ctx or current_context())
+        dev = _dev(self._ctx)
+        self._csr_data = jax.device_put(
+            jnp.asarray(data, self.dtype), dev)
+        self._csr_indices = jax.device_put(
+            jnp.asarray(indices, jnp.int32), dev)
+        self._csr_indptr = jax.device_put(
+            jnp.asarray(indptr, jnp.int32), dev)
+        if len(self.shape) != 2:
+            raise MXNetError("CSR arrays are 2-D")
+
+    # reference component accessors
+    @property
+    def data(self):
+        return NDArray(self._csr_data)
+
+    @property
+    def indices(self):
+        return NDArray(self._csr_indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._csr_indptr)
+
+    @property
+    def nnz(self):
+        return int(self._csr_data.shape[0])
+
+    def todense(self):
+        n, m = self.shape
+        # row id per nonzero from indptr: static-shape searchsorted
+        rows = jnp.searchsorted(self._csr_indptr,
+                                jnp.arange(self.nnz, dtype=jnp.int32),
+                                side="right") - 1
+        dense = jnp.zeros((n, m), self.dtype).at[
+            rows, self._csr_indices].add(self._csr_data)
+        return NDArray(dense)
+
+    def astype(self, dtype):
+        return CSRNDArray(self._csr_data.astype(dtype), self._csr_indices,
+                          self._csr_indptr, self.shape, dtype, self._ctx)
+
+    def _row_ids(self):
+        return jnp.searchsorted(self._csr_indptr,
+                                jnp.arange(self.nnz, dtype=jnp.int32),
+                                side="right") - 1
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = self.shape[0] if key.stop is None else key.stop
+            if key.step not in (None, 1):
+                raise MXNetError("CSR slicing supports step 1 only")
+            d = self.todense()._data[start:stop]
+            return array(np.asarray(d), ctx=self._ctx)
+        raise MXNetError("CSR indexing supports row slices only")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor (reference: ``RowSparseNDArray``): a subset of
+    rows is stored -- ``indices`` (k,) row ids, ``data`` (k, *row_shape).
+    The embedding-gradient / kvstore workhorse."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None, ctx=None):
+        dtype = dtype or getattr(data, "dtype", np.float32)
+        super().__init__(shape, dtype, ctx or current_context())
+        dev = _dev(self._ctx)
+        self._rs_data = jax.device_put(jnp.asarray(data, self.dtype), dev)
+        self._rs_indices = jax.device_put(
+            jnp.asarray(indices, jnp.int32), dev)
+        if self._rs_data.shape[1:] != self.shape[1:]:
+            raise MXNetError(
+                "row data shape %s does not match dense shape %s"
+                % (self._rs_data.shape, self.shape))
+
+    @property
+    def data(self):
+        return NDArray(self._rs_data)
+
+    @property
+    def indices(self):
+        return NDArray(self._rs_indices)
+
+    def todense(self):
+        dense = jnp.zeros(self.shape, self.dtype).at[
+            self._rs_indices].add(self._rs_data)
+        return NDArray(dense)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self._rs_data.astype(dtype),
+                                self._rs_indices, self.shape, dtype,
+                                self._ctx)
+
+    def retain(self, row_ids):
+        """Keep only ``row_ids`` rows (reference: ``sparse.retain``).
+        Static output shape: len(row_ids) rows; absent rows are zero."""
+        rows = row_ids._data if isinstance(row_ids, NDArray) \
+            else jnp.asarray(row_ids, jnp.int32)
+        rows = rows.astype(jnp.int32)
+        # membership of each kept row in the stored set
+        eq = rows[:, None] == self._rs_indices[None, :]   # (k', k)
+        hit = eq.any(axis=1)
+        src = jnp.argmax(eq, axis=1)
+        picked = jnp.where(
+            hit.reshape((-1,) + (1,) * (self._rs_data.ndim - 1)),
+            self._rs_data[src], 0)
+        return RowSparseNDArray(picked, rows, self.shape, self.dtype,
+                                self._ctx)
+
+
+# ----------------------------------------------------------------------
+# Constructors (reference: sparse.py module functions)
+# ----------------------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray from (data, indices, indptr) or a dense
+    array-like (reference: ``sparse.csr_matrix``)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape required with (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, dtype, ctx)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                       else arg1, dtype or np.float32)
+    if dense.ndim != 2:
+        raise MXNetError("csr_matrix needs a 2-D input")
+    mask = dense != 0
+    indptr = np.concatenate([[0], np.cumsum(mask.sum(axis=1))]) \
+        .astype(np.int32)
+    indices = np.nonzero(mask)[1].astype(np.int32)
+    data = dense[mask]
+    return CSRNDArray(data, indices, indptr, dense.shape,
+                      dtype or dense.dtype, ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or dense
+    (reference: ``sparse.row_sparse_array``)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            data = np.asarray(data)
+            raise MXNetError("shape required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape, dtype, ctx)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                       else arg1, dtype or np.float32)
+    live = np.nonzero((dense != 0).reshape(dense.shape[0], -1)
+                      .any(axis=1))[0].astype(np.int32)
+    return RowSparseNDArray(dense[live], live, dense.shape,
+                            dtype or dense.dtype, ctx)
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-preserving array constructor (reference:
+    ``sparse.array``)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    return csr_matrix(source, ctx=ctx, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """Reference: ``sparse.zeros``."""
+    if stype == "csr":
+        return CSRNDArray(np.zeros((0,), dtype), np.zeros((0,), np.int32),
+                          np.zeros((shape[0] + 1,), np.int32), shape,
+                          dtype, ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            np.zeros((0,) + tuple(shape[1:]), dtype),
+            np.zeros((0,), np.int32), shape, dtype, ctx)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+# ----------------------------------------------------------------------
+# Operators (reference: dot_op / elemwise sparse kernels)
+# ----------------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """``csr · dense`` and ``csr^T · dense`` (reference: sparse ``dot``,
+    the libsvm-data matmul).  Lowers to static-shape segment-sum --
+    dense-tiled, no dynamic shapes."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        if transpose_b:
+            raise MXNetError("transpose_b unsupported for csr dot")
+        rows = lhs._row_ids()
+        cols = lhs._csr_indices
+        vals = lhs._csr_data
+        if not transpose_a:
+            # out[r, :] = sum_nz vals * rhs[cols]
+            contrib = vals[:, None] * rhs._data[cols]      # (nnz, m)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=lhs.shape[0])
+            return NDArray(out)
+        contrib = vals[:, None] * rhs._data[rows]
+        out = jax.ops.segment_sum(contrib, cols,
+                                  num_segments=lhs.shape[1])
+        return NDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from . import dot as _dense_dot
+        return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                          transpose_b=transpose_b)
+    raise MXNetError("sparse.dot supports csr x dense")
+
+
+def retain(data, indices):
+    """Reference: ``sparse.retain``."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    return data.retain(indices)
+
+
+def elemwise_add(lhs, rhs):
+    """row_sparse + row_sparse -> row_sparse (union of rows);
+    sparse + dense and dense + dense -> dense."""
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("shape mismatch %s vs %s"
+                             % (lhs.shape, rhs.shape))
+        # sparse arrays hold CONCRETE index arrays (they are storage, not
+        # traced compute -- module docstring), so the row union is exact
+        # host-side: no padding, no phantom rows
+        idx = np.concatenate([np.asarray(lhs._rs_indices),
+                              np.asarray(rhs._rs_indices)])
+        dat = jnp.concatenate([lhs._rs_data, rhs._rs_data])
+        uniq, inv = np.unique(idx, return_inverse=True)
+        summed = jax.ops.segment_sum(dat, jnp.asarray(inv.reshape(-1)),
+                                     num_segments=len(uniq))
+        return RowSparseNDArray(summed, uniq.astype(np.int32),
+                                lhs.shape, lhs.dtype, lhs._ctx)
+    if isinstance(lhs, NDArray) and isinstance(rhs, RowSparseNDArray):
+        lhs, rhs = rhs, lhs
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        out = rhs._data.at[lhs._rs_indices].add(lhs._rs_data)
+        return NDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return NDArray(lhs._data + rhs._data)
+    raise MXNetError("unsupported operand storage types")
+
+
+add = elemwise_add

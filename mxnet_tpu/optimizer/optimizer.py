@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import pickle
 
+import jax.numpy as jnp
+
 import numpy as np
 
 from ..base import MXNetError
@@ -124,6 +126,36 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    def _sparse_grad_prep(self, index, grad, weight_rows):
+        """Scaled/clipped row gradient + per-row weight decay term."""
+        g = grad.data._data * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        wd = self._get_wd(index)
+        if wd:
+            g = g + wd * weight_rows
+        return g
+
+    def update_row_sparse(self, index, weight, grad, state):
+        """Row-sparse gradient update touching only the live rows
+        (reference: the sparse sgd/adagrad kernels in
+        ``src/operator/optimizer_op.cc``).  Default: densify -- correct
+        for every optimizer; SGD/AdaGrad override with real row updates.
+        """
+        self.update(index, weight, grad.todense(), state)
+
+    def update_row_sparse_multi_precision(self, index, weight, grad,
+                                          state):
+        """Sparse entry point honoring the fp32-master-copy contract:
+        with multi-precision active the state is (mom, w32)-style and the
+        master copy must stay in sync, so the update runs through the
+        dense multi-precision path."""
+        if self.multi_precision and weight.dtype == np.float16:
+            self.update_multi_precision(index, weight, grad.todense(),
+                                        state)
+        else:
+            self.update_row_sparse(index, weight, grad, state)
+
 
 def create(name, **kwargs):
     return Optimizer.create_optimizer(name, **kwargs)
@@ -151,6 +183,20 @@ class SGD(Optimizer):
             weight._data, state._data = w._data, m._data
         else:
             weight._data = nd.sgd_update(weight, grad, **kw)._data
+
+    def update_row_sparse(self, index, weight, grad, state):
+        """Lazy row update (reference: sparse ``sgd_update`` with
+        ``lazy_update=True``): only rows with gradient move; with
+        momentum the reference semantics require the full-state update,
+        so it densifies."""
+        if self.momentum != 0.0:
+            return super().update_row_sparse(index, weight, grad, state)
+        self._update_count(index)
+        lr = self._get_lr(index)
+        rows = grad.indices._data
+        g = self._sparse_grad_prep(index, grad, weight._data[rows])
+        weight._data = weight._data.at[rows].add(
+            (-lr * g).astype(weight._data.dtype))
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == np.float16:
@@ -320,6 +366,19 @@ class AdaGrad(Optimizer):
                                  epsilon=self.float_stable_eps, **kw)
         weight._data, state._data = w._data, h._data
 
+    def update_row_sparse(self, index, weight, grad, state):
+        """Sparse AdaGrad (reference: ``_sparse_adagrad_update``): only
+        the live rows accumulate history and move."""
+        self._update_count(index)
+        lr = self._get_lr(index)
+        rows = grad.indices._data
+        g = self._sparse_grad_prep(index, grad, weight._data[rows])
+        h_rows = state._data[rows] + g * g
+        state._data = state._data.at[rows].set(h_rows)
+        weight._data = weight._data.at[rows].add(
+            (-lr * g / (jnp.sqrt(h_rows) + self.float_stable_eps))
+            .astype(weight._data.dtype))
+
 
 @register
 class Ftrl(Optimizer):
@@ -455,6 +514,11 @@ class Updater:
         if index not in self.states:
             self.states[index] = \
                 self.optimizer.create_state_multi_precision(index, weight)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            self.optimizer.update_row_sparse_multi_precision(
+                index, weight, grad, self.states[index])
+            return
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 

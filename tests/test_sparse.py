@@ -1,0 +1,148 @@
+"""Sparse NDArray tests (reference:
+``tests/python/unittest/test_sparse_ndarray.py`` /
+``test_sparse_operator.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_csr(n, m, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(n, m) * (rng.rand(n, m) < density)
+    return dense.astype(np.float32)
+
+
+def test_csr_roundtrip():
+    dense = _rand_csr(8, 5)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense, rtol=1e-6)
+    # component access matches scipy-style construction
+    assert csr.indptr.shape == (9,)
+    assert csr.nnz == int((dense != 0).sum())
+    # explicit (data, indices, indptr) constructor
+    csr2 = sparse.csr_matrix(
+        (csr.data.asnumpy(), csr.indices.asnumpy(),
+         csr.indptr.asnumpy()), shape=(8, 5))
+    np.testing.assert_allclose(csr2.asnumpy(), dense, rtol=1e-6)
+
+
+def test_csr_dot_dense():
+    dense = _rand_csr(8, 5)
+    rhs = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    csr = sparse.csr_matrix(dense)
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5)
+    outT = sparse.dot(csr, mx.nd.array(
+        np.random.RandomState(2).randn(8, 3).astype(np.float32)),
+        transpose_a=True)
+    assert outT.shape == (5, 3)
+
+
+def test_row_sparse_roundtrip_and_retain():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3) + 1
+    idx = np.array([1, 3, 5, 7], dtype=np.int32)
+    rs = sparse.row_sparse_array((data, idx), shape=(10, 3))
+    dense = rs.asnumpy()
+    assert dense.shape == (10, 3)
+    np.testing.assert_allclose(dense[idx], data)
+    assert dense.sum() == data.sum()
+
+    kept = rs.retain(mx.nd.array(np.array([3, 4, 7], np.float32)))
+    np.testing.assert_allclose(kept.asnumpy()[[3, 7]], data[[1, 3]])
+    assert kept.asnumpy()[4].sum() == 0
+
+
+def test_row_sparse_add():
+    a = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 2])), shape=(5, 3))
+    b = sparse.row_sparse_array(
+        (2 * np.ones((2, 3), np.float32), np.array([2, 4])), shape=(5, 3))
+    s = sparse.elemwise_add(a, b)
+    assert s.stype == "row_sparse"
+    expect = np.zeros((5, 3), np.float32)
+    expect[0] = 1
+    expect[2] = 3
+    expect[4] = 2
+    np.testing.assert_allclose(s.asnumpy(), expect)
+    # sparse + dense -> dense
+    d = sparse.elemwise_add(a, mx.nd.ones((5, 3)))
+    np.testing.assert_allclose(
+        d.asnumpy(), np.ones((5, 3)) + a.asnumpy())
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (6, 2))
+    assert z.asnumpy().sum() == 0
+    zc = sparse.zeros("csr", (4, 4))
+    assert zc.asnumpy().sum() == 0
+
+
+def test_kvstore_row_sparse_pull_no_densify():
+    kv = mx.kv.create("local")
+    table = np.random.RandomState(0).randn(100, 8).astype(np.float32)
+    kv.init("emb", mx.nd.array(table))
+    rows = mx.nd.array(np.array([5, 17, 99], np.float32))
+    pulled = kv.row_sparse_pull("emb", row_ids=rows)
+    assert pulled.stype == "row_sparse"
+    assert pulled.data.shape == (3, 8)      # only k rows moved
+    np.testing.assert_allclose(pulled.data.asnumpy(),
+                               table[[5, 17, 99]], rtol=1e-6)
+
+
+def test_kvstore_sparse_push_with_optimizer():
+    """Pushing row-sparse grads applies a row-level update server-side
+    (reference: sparse sgd on the kvstore server)."""
+    kv = mx.kv.create("local")
+    w0 = np.ones((10, 4), np.float32)
+    kv.init("w", mx.nd.array(w0))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, momentum=0.0))
+    g = sparse.row_sparse_array(
+        (np.ones((2, 4), np.float32), np.array([2, 7])), shape=(10, 4))
+    kv.push("w", g)
+    out = mx.nd.zeros((10, 4))
+    kv.pull("w", out=out)
+    got = out.asnumpy()
+    expect = w0.copy()
+    expect[[2, 7]] -= 0.5
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_sparse_adagrad_rows_only():
+    opt = mx.optimizer.AdaGrad(learning_rate=1.0)
+    w = mx.nd.ones((6, 2))
+    state = opt.create_state(0, w)
+    g = sparse.row_sparse_array(
+        (np.full((2, 2), 2.0, np.float32), np.array([1, 4])), shape=(6, 2))
+    opt.update_row_sparse(0, w, g, state)
+    got = w.asnumpy()
+    assert np.allclose(got[[0, 2, 3, 5]], 1.0)     # untouched rows
+    assert (got[[1, 4]] < 1.0).all()                # updated rows
+    h = state.asnumpy()
+    assert np.allclose(h[[0, 2, 3, 5]], 0.0)
+    assert np.allclose(h[[1, 4]], 4.0)
+
+
+def test_updater_dispatches_sparse():
+    upd = mx.optimizer.get_updater(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.0))
+    w = mx.nd.ones((5, 3))
+    g = sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), np.array([3])), shape=(5, 3))
+    upd(0, g, w)
+    got = w.asnumpy()
+    assert np.allclose(got[3], 0.9) and np.allclose(got[0], 1.0)
+
+
+def test_momentum_sgd_densifies_correctly():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w = mx.nd.ones((4, 2))
+    state = opt.create_state(0, w)
+    g = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([1])), shape=(4, 2))
+    opt.update_row_sparse(0, w, g, state)   # falls back to dense math
+    got = w.asnumpy()
+    assert not np.allclose(got[1], 1.0)
+    assert np.allclose(got[0], 1.0)
