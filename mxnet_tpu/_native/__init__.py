@@ -1,0 +1,81 @@
+"""Native runtime components, compiled on demand.
+
+The C++ sources live next to this file; at first import they are built
+with the system toolchain (g++ -O2 -shared -fPIC) into a cached shared
+library, loaded via ctypes.  No native toolchain, or a failed build,
+degrades gracefully: callers get ``None`` and use the pure-Python path.
+Set ``MXNET_TPU_NATIVE=0`` to force the Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+
+_LIB = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "recordio_native.cc")
+
+
+def _cache_dir():
+    d = os.environ.get("MXNET_TPU_NATIVE_CACHE",
+                       os.path.expanduser("~/.cache/mxnet_tpu/native"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build(src, out):
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise RuntimeError("native build failed:\n%s" % proc.stderr[-2000:])
+
+
+def load():
+    """Return the loaded native library, or None when unavailable."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("MXNET_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        so = os.path.join(_cache_dir(), "librecordio_native.so")
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(_SRC)):
+            _build(_SRC, so)
+        lib = ctypes.CDLL(so)
+        lib.rio_open.restype = ctypes.c_void_p
+        lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rio_close.argtypes = [ctypes.c_void_p]
+        lib.rio_tell.restype = ctypes.c_long
+        lib.rio_tell.argtypes = [ctypes.c_void_p]
+        lib.rio_seek.restype = ctypes.c_int
+        lib.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.rio_flush.restype = ctypes.c_int
+        lib.rio_flush.argtypes = [ctypes.c_void_p]
+        lib.rio_write.restype = ctypes.c_int
+        lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_long]
+        # out-pointers are void*: a c_char_p restype/arg would make
+        # ctypes copy to Python bytes and lose the malloc'd pointer,
+        # so rio_free would free a Python-owned buffer (heap abort)
+        lib.rio_read.restype = ctypes.c_long
+        lib.rio_read.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_void_p)]
+        lib.rio_free.argtypes = [ctypes.c_void_p]
+        lib.rio_read_batch.restype = ctypes.c_int
+        lib.rio_read_batch.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_long),
+            ctypes.c_int]
+        _LIB = lib
+    except Exception as e:  # no toolchain / build error: Python fallback
+        warnings.warn("mxnet_tpu native components unavailable (%s); "
+                      "using pure-Python recordio" % e)
+        _LIB = None
+    return _LIB

@@ -1,0 +1,7 @@
+"""Importable probe for native-component availability (used by
+``mx.runtime.Features()['NATIVE_RECORDIO']``).  Import succeeds only if
+the native library is built and loadable."""
+from ._native import load
+
+if load() is None:
+    raise ImportError("mxnet_tpu native library unavailable")

@@ -121,6 +121,22 @@ class KVStore:
             return value
         return value._data
 
+    def _reduce_for_update(self, key, value):
+        """Merge + compress + cross-process reduce one pushed value.
+        Returns ``(merged, sparse_grad)``; sparse grads skip compression
+        and densify before the dist collective (row unions differ per
+        worker; the collective needs a static shape)."""
+        merged = self._merge(value)
+        sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
+        if not sparse_grad and self._compression is not None:
+            merged = self._compression.compress_decompress(key, merged)
+        if self._is_dist and sparse_grad:
+            merged = merged.todense()._data
+            sparse_grad = False
+        if self._is_dist:
+            merged = _allreduce_across_processes(merged)
+        return merged, sparse_grad
+
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
@@ -129,17 +145,7 @@ class KVStore:
         key = self._keyify(key)
         if key not in self._store:
             raise MXNetError("kvstore key %r not initialized" % key)
-        merged = self._merge(value)
-        sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
-        if not sparse_grad and self._compression is not None:
-            merged = self._compression.compress_decompress(key, merged)
-        if self._is_dist and sparse_grad:
-            # cross-process reduction is dense (row unions differ per
-            # worker; the collective needs a static shape)
-            merged = merged.todense()._data
-            sparse_grad = False
-        if self._is_dist:
-            merged = _allreduce_across_processes(merged)
+        merged, sparse_grad = self._reduce_for_update(key, value)
         if self._updater is not None:
             grad = merged if sparse_grad else NDArray(merged)
             self._updater(key, grad, self._store[key])
@@ -194,15 +200,7 @@ class KVStore:
                 self.pushpull(k, v, o, priority)
             return
         key = self._keyify(key)
-        merged = self._merge(value)
-        sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
-        if not sparse_grad and self._compression is not None:
-            merged = self._compression.compress_decompress(key, merged)
-        if self._is_dist and sparse_grad:
-            merged = merged.todense()._data
-            sparse_grad = False
-        if self._is_dist:
-            merged = _allreduce_across_processes(merged)
+        merged, sparse_grad = self._reduce_for_update(key, value)
         if self._updater is not None:
             if key not in self._store:
                 raise MXNetError("kvstore key %r not initialized" % key)

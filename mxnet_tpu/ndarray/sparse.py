@@ -121,12 +121,8 @@ class CSRNDArray(BaseSparseNDArray):
 
     def todense(self):
         n, m = self.shape
-        # row id per nonzero from indptr: static-shape searchsorted
-        rows = jnp.searchsorted(self._csr_indptr,
-                                jnp.arange(self.nnz, dtype=jnp.int32),
-                                side="right") - 1
         dense = jnp.zeros((n, m), self.dtype).at[
-            rows, self._csr_indices].add(self._csr_data)
+            self._row_ids(), self._csr_indices].add(self._csr_data)
         return NDArray(dense)
 
     def astype(self, dtype):
@@ -134,6 +130,7 @@ class CSRNDArray(BaseSparseNDArray):
                           self._csr_indptr, self.shape, dtype, self._ctx)
 
     def _row_ids(self):
+        # row id per nonzero from indptr: static-shape searchsorted
         return jnp.searchsorted(self._csr_indptr,
                                 jnp.arange(self.nnz, dtype=jnp.int32),
                                 side="right") - 1
@@ -145,7 +142,8 @@ class CSRNDArray(BaseSparseNDArray):
             if key.step not in (None, 1):
                 raise MXNetError("CSR slicing supports step 1 only")
             d = self.todense()._data[start:stop]
-            return array(np.asarray(d), ctx=self._ctx)
+            return csr_matrix(np.asarray(d), ctx=self._ctx,
+                              dtype=self.dtype)
         raise MXNetError("CSR indexing supports row slices only")
 
 
@@ -192,6 +190,11 @@ class RowSparseNDArray(BaseSparseNDArray):
         rows = row_ids._data if isinstance(row_ids, NDArray) \
             else jnp.asarray(row_ids, jnp.int32)
         rows = rows.astype(jnp.int32)
+        if self._rs_data.shape[0] == 0:
+            picked = jnp.zeros((rows.shape[0],) + self.shape[1:],
+                               self.dtype)
+            return RowSparseNDArray(picked, rows, self.shape, self.dtype,
+                                    self._ctx)
         # membership of each kept row in the stored set
         eq = rows[:, None] == self._rs_indices[None, :]   # (k', k)
         hit = eq.any(axis=1)
@@ -216,7 +219,9 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
             raise MXNetError("shape required with (data, indices, indptr)")
         return CSRNDArray(data, indices, indptr, shape, dtype, ctx)
     dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
-                       else arg1, dtype or np.float32)
+                       else arg1)
+    if dtype is not None:
+        dense = dense.astype(dtype)
     if dense.ndim != 2:
         raise MXNetError("csr_matrix needs a 2-D input")
     mask = dense != 0
@@ -234,8 +239,12 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         if shape is None:
+            # infer the dense shape (reference behavior): enough rows to
+            # hold the largest index
             data = np.asarray(data)
-            raise MXNetError("shape required with (data, indices)")
+            idx = np.asarray(indices)
+            nrows = int(idx.max()) + 1 if idx.size else 0
+            shape = (nrows,) + tuple(data.shape[1:])
         return RowSparseNDArray(data, indices, shape, dtype, ctx)
     dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
                        else arg1, dtype or np.float32)
@@ -277,19 +286,23 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
         if transpose_b:
             raise MXNetError("transpose_b unsupported for csr dot")
+        if rhs._data.ndim not in (1, 2):
+            raise MXNetError("csr dot expects a 1-D or 2-D dense rhs")
+        vec = rhs._data.ndim == 1
+        rhs_mat = rhs._data[:, None] if vec else rhs._data
         rows = lhs._row_ids()
         cols = lhs._csr_indices
         vals = lhs._csr_data
         if not transpose_a:
             # out[r, :] = sum_nz vals * rhs[cols]
-            contrib = vals[:, None] * rhs._data[cols]      # (nnz, m)
+            contrib = vals[:, None] * rhs_mat[cols]        # (nnz, m)
             out = jax.ops.segment_sum(contrib, rows,
                                       num_segments=lhs.shape[0])
-            return NDArray(out)
-        contrib = vals[:, None] * rhs._data[rows]
-        out = jax.ops.segment_sum(contrib, cols,
-                                  num_segments=lhs.shape[1])
-        return NDArray(out)
+        else:
+            contrib = vals[:, None] * rhs_mat[rows]
+            out = jax.ops.segment_sum(contrib, cols,
+                                      num_segments=lhs.shape[1])
+        return NDArray(out[:, 0] if vec else out)
     if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         from . import dot as _dense_dot
         return _dense_dot(lhs, rhs, transpose_a=transpose_a,

@@ -126,14 +126,18 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
-    def _sparse_grad_prep(self, index, grad, weight_rows):
-        """Scaled/clipped row gradient + per-row weight decay term."""
+    def _sparse_grad_prep(self, index, grad, weight_rows, fold_wd=True):
+        """Scaled/clipped row gradient; with ``fold_wd`` the per-row
+        weight-decay term is folded in (matching the dense
+        ``_apply_wd`` kernel).  AdaGrad keeps wd OUT of the squared
+        history, so it passes ``fold_wd=False``."""
         g = grad.data._data * self.rescale_grad
         if self.clip_gradient is not None and self.clip_gradient > 0:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        wd = self._get_wd(index)
-        if wd:
-            g = g + wd * weight_rows
+        if fold_wd:
+            wd = self._get_wd(index)
+            if wd:
+                g = g + wd * weight_rows
         return g
 
     def update_row_sparse(self, index, weight, grad, state):
@@ -368,16 +372,20 @@ class AdaGrad(Optimizer):
 
     def update_row_sparse(self, index, weight, grad, state):
         """Sparse AdaGrad (reference: ``_sparse_adagrad_update``): only
-        the live rows accumulate history and move."""
+        the live rows accumulate history and move.  Same math as the
+        dense ``adagrad_update`` kernel: wd stays OUT of the squared
+        history, epsilon inside the sqrt."""
         self._update_count(index)
         lr = self._get_lr(index)
+        wd = self._get_wd(index)
         rows = grad.indices._data
-        g = self._sparse_grad_prep(index, grad, weight._data[rows])
+        w_rows = weight._data[rows]
+        g = self._sparse_grad_prep(index, grad, w_rows, fold_wd=False)
         h_rows = state._data[rows] + g * g
         state._data = state._data.at[rows].set(h_rows)
+        step = g / jnp.sqrt(h_rows + self.float_stable_eps) + wd * w_rows
         weight._data = weight._data.at[rows].add(
-            (-lr * g / (jnp.sqrt(h_rows) + self.float_stable_eps))
-            .astype(weight._data.dtype))
+            (-lr * step).astype(weight._data.dtype))
 
 
 @register

@@ -29,27 +29,49 @@ _HEADER_SIZE = struct.calcsize(_HEADER_FMT)
 IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
 
 
+def _native_lib():
+    from ._native import load
+    return load()
+
+
 class MXRecordIO:
-    """Sequential record reader/writer (reference: ``MXRecordIO``)."""
+    """Sequential record reader/writer (reference: ``MXRecordIO``).
+
+    IO runs through the C++ engine (``_native/recordio_native.cc`` --
+    buffered framing, thread-pooled batch reads) when the native library
+    is available, with a byte-identical pure-Python fallback.
+    """
 
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
         self.record = None
+        self._nh = None          # native handle
         self.open()
 
     def open(self):
         if self.flag == "w":
-            self.record = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.record = open(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("invalid flag %r" % self.flag)
+        lib = _native_lib()
+        if lib is not None:
+            h = lib.rio_open(self.uri.encode(), 1 if self.writable else 0)
+            if not h:
+                raise MXNetError("cannot open %r" % self.uri)
+            self._nh = h
+            self.record = True   # sentinel: "open"
+            return
+        self.record = open(self.uri, "wb" if self.writable else "rb")
 
     def close(self):
-        if self.record is not None:
+        if self._nh is not None:
+            _native_lib().rio_close(self._nh)
+            self._nh = None
+            self.record = None
+        elif self.record is not None:
             self.record.close()
             self.record = None
 
@@ -70,6 +92,11 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._nh is not None:
+            # a buffered write may not be visible to ftell-reported file
+            # offsets used by the .idx sidecar, so tell() is exact: the
+            # native side tracks the logical position through the buffer
+            return int(_native_lib().rio_tell(self._nh))
         return self.record.tell()
 
     _MAX_CHUNK = (1 << 29) - 1
@@ -85,6 +112,11 @@ class MXRecordIO:
     def write(self, buf):
         if not self.writable:
             raise MXNetError("not opened for writing")
+        if self._nh is not None:
+            if _native_lib().rio_write(self._nh, bytes(buf),
+                                       len(buf)) != 0:
+                raise MXNetError("recordio write failed")
+            return
         # The length field is 29 bits; larger payloads split into
         # cflag 1 (first) / 2 (middle) / 3 (last) chunks, matching the
         # dmlc recordio framing, so the reader never desynchronizes.
@@ -100,6 +132,17 @@ class MXRecordIO:
     def read(self):
         if self.writable:
             raise MXNetError("not opened for reading")
+        if self._nh is not None:
+            lib = _native_lib()
+            out = ctypes.c_void_p()
+            n = lib.rio_read(self._nh, ctypes.byref(out))
+            if n == -1:
+                return None
+            if n < 0:
+                raise MXNetError("corrupt recordio: bad frame")
+            data = ctypes.string_at(out, n)
+            lib.rio_free(out)
+            return data
         data = b""
         while True:
             hdr = self.record.read(8)
@@ -151,11 +194,47 @@ class MXIndexedRecordIO(MXRecordIO):
             self.fidx = None
 
     def seek(self, idx):
+        if self._nh is not None:
+            if _native_lib().rio_seek(self._nh, self.idx[idx]) != 0:
+                raise MXNetError("seek failed for key %r" % (idx,))
+            return
         self.record.seek(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
         return self.read()
+
+    def read_batch(self, keys, nthreads=4):
+        """Read many records concurrently (reference: the threaded
+        record loader in ``iter_image_recordio_2.cc``).  Uses the native
+        thread-pooled batch reader when available; otherwise sequential.
+        """
+        lib = _native_lib()
+        if lib is None or self.writable:
+            return [self.read_idx(k) for k in keys]
+        n = len(keys)
+        offsets = (ctypes.c_long * n)(*[self.idx[k] for k in keys])
+        bufs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_long * n)()
+        rc = lib.rio_read_batch(self.uri.encode(), offsets, n, bufs, lens,
+                                int(nthreads))
+        # harvest/free EVERY allocated buffer before raising: an early
+        # raise would leak the rest of the batch's native heap
+        out, bad = [], None
+        for i in range(n):
+            if lens[i] < 0 or bufs[i] is None:
+                if bad is None:
+                    bad = keys[i]
+                out.append(None)
+            else:
+                out.append(ctypes.string_at(bufs[i], lens[i]))
+            if bufs[i]:
+                lib.rio_free(bufs[i])
+        if rc != 0:
+            raise MXNetError("cannot open %r for batch read" % self.uri)
+        if bad is not None:
+            raise MXNetError("corrupt record at key %r" % (bad,))
+        return out
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -1,0 +1,92 @@
+"""Native recordio engine tests (reference: dmlc-core recordio framing
+tests + ``test_recordio.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import recordio
+from mxnet_tpu._native import load
+
+native = pytest.mark.skipif(load() is None,
+                            reason="native library unavailable")
+
+
+def _write_file(tmp_path, payloads, force_python=False):
+    rec = str(tmp_path / "f.rec")
+    idx = str(tmp_path / "f.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    if force_python:
+        assert w._nh is None
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+    return idx, rec
+
+
+@native
+def test_native_round_trip(tmp_path):
+    rng = np.random.RandomState(0)
+    payloads = [bytes(rng.bytes(rng.randint(1, 4096))) for _ in range(64)]
+    idx, rec = _write_file(tmp_path, payloads)
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r._nh is not None
+    for i in (0, 63, 31, 1):
+        assert r.read_idx(i) == payloads[i]
+    assert r.read_batch(list(range(64)), nthreads=4) == payloads
+    r.close()
+
+
+@native
+def test_native_python_byte_compat(tmp_path, monkeypatch):
+    """Files written natively parse with the Python reader and vice
+    versa -- same dmlc framing on disk."""
+    payloads = [b"a" * 7, b"bb", b"c" * 1000]
+    idx, rec = _write_file(tmp_path, payloads)
+
+    import mxnet_tpu._native as nat
+    monkeypatch.setenv("MXNET_TPU_NATIVE", "0")
+    monkeypatch.setattr(nat, "_TRIED", False)
+    monkeypatch.setattr(nat, "_LIB", None)
+    r = recordio.MXRecordIO(rec, "r")
+    assert r._nh is None
+    got = []
+    while True:
+        x = r.read()
+        if x is None:
+            break
+        got.append(x)
+    assert got == payloads
+
+    # python-written file, native reader
+    py_rec = str(tmp_path / "py.rec")
+    w = recordio.MXRecordIO(py_rec, "w")
+    assert w._nh is None
+    for p in payloads:
+        w.write(p)
+    w.close()
+    monkeypatch.setenv("MXNET_TPU_NATIVE", "1")
+    monkeypatch.setattr(nat, "_TRIED", False)
+    monkeypatch.setattr(nat, "_LIB", None)
+    rn = recordio.MXRecordIO(py_rec, "r")
+    assert rn._nh is not None
+    assert [rn.read(), rn.read(), rn.read()] == payloads
+    assert rn.read() is None
+
+
+@native
+def test_native_corrupt_detection(tmp_path):
+    bad = str(tmp_path / "bad.rec")
+    with open(bad, "wb") as f:
+        f.write(b"\x00" * 16)
+    r = recordio.MXRecordIO(bad, "r")
+    with pytest.raises(Exception):
+        r.read()
+
+
+def test_pack_unpack_headers():
+    hdr = recordio.IRHeader(0, 3.5, 42, 0)
+    s = recordio.pack(hdr, b"payload")
+    h2, body = recordio.unpack(s)
+    assert body == b"payload"
+    assert h2.label == 3.5 and h2.id == 42
