@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""opperf: per-operator micro-benchmark sweep (reference:
+``benchmark/opperf/opperf.py``).
+
+Times every registered op it can synthesize inputs for, on the default
+device, measuring steady-state dispatch+execute latency through the
+SAME eager path users hit (the persistent per-op jit cache).  Prints one
+JSON object per op and a summary line; ``--json FILE`` dumps the full
+table.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+# ops with simple (data,) or (lhs, rhs) tensor signatures we can drive
+# blind; everything else needs the curated entries below
+_CURATED = {
+    "FullyConnected": (lambda mx, np: ([mx.nd.array(np.random.randn(
+        32, 64).astype(np.float32)), mx.nd.array(np.random.randn(
+            128, 64).astype(np.float32)), mx.nd.array(np.zeros(
+                128, np.float32))], {"num_hidden": 128})),
+    "Convolution": (lambda mx, np: ([mx.nd.array(np.random.randn(
+        8, 8, 16, 16).astype(np.float32)), mx.nd.array(np.random.randn(
+            16, 8, 3, 3).astype(np.float32)), mx.nd.array(np.zeros(
+                16, np.float32))], {"num_filter": 16, "kernel": (3, 3),
+                                    "pad": (1, 1)})),
+    "dot": (lambda mx, np: ([mx.nd.array(np.random.randn(
+        128, 128).astype(np.float32))] * 2, {})),
+    "batch_dot": (lambda mx, np: ([mx.nd.array(np.random.randn(
+        8, 64, 64).astype(np.float32))] * 2, {})),
+    "softmax": None, "relu": None, "sigmoid": None, "tanh": None,
+    "exp": None, "log": None, "sqrt": None, "square": None,
+    "sum": None, "mean": None, "max": None, "min": None, "argmax": None,
+    "elemwise_add": None, "elemwise_mul": None, "broadcast_add": None,
+    "broadcast_mul": None, "transpose": None, "reshape_like": None,
+    "abs": None, "negative": None, "LayerNorm": (lambda mx, np: (
+        [mx.nd.array(np.random.randn(32, 128).astype(np.float32)),
+         mx.nd.ones((128,)), mx.nd.zeros((128,))], {})),
+}
+
+_UNARY = {"softmax", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
+          "square", "sum", "mean", "max", "min", "argmax", "transpose",
+          "abs", "negative"}
+_BINARY = {"elemwise_add", "elemwise_mul", "broadcast_add",
+           "broadcast_mul", "reshape_like"}
+
+
+def run(ops=None, warmup=5, runs=50, shape=(64, 64)):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops.registry import OP_REGISTRY
+
+    x = mx.nd.array(np.random.rand(*shape).astype(np.float32) + 0.5)
+    results = []
+    names = ops or sorted(_CURATED)
+    for name in names:
+        if name not in OP_REGISTRY:
+            continue
+        spec = _CURATED.get(name)
+        if spec is not None:
+            args, kwargs = spec(mx, np)
+        elif name in _UNARY:
+            args, kwargs = [x], {}
+        elif name in _BINARY:
+            args, kwargs = [x, x], {}
+        else:
+            continue
+        fn = getattr(mx.nd, name)
+        try:
+            for _ in range(warmup):
+                out = fn(*args, **kwargs)
+            mx.nd.waitall()
+            t0 = time.time()
+            for _ in range(runs):
+                out = fn(*args, **kwargs)
+            mx.nd.waitall()
+            dt = (time.time() - t0) / runs
+            results.append({"op": name, "avg_us": round(dt * 1e6, 2)})
+        except Exception as e:
+            results.append({"op": name, "error": str(e)[:120]})
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ops", nargs="*", default=None)
+    p.add_argument("--runs", type=int, default=50)
+    p.add_argument("--json", default=None)
+    args = p.parse_args(argv)
+    results = run(ops=args.ops, runs=args.runs)
+    for r in results:
+        print(json.dumps(r))
+    ok = [r for r in results if "avg_us" in r]
+    print(json.dumps({"opperf_ops": len(ok),
+                      "median_us": sorted(r["avg_us"] for r in ok)[
+                          len(ok) // 2] if ok else None}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,4 +64,5 @@ from . import module
 from . import module as mod
 from . import profiler
 from . import runtime
+from .distributed import distributed_init
 from . import test_utils

@@ -28,11 +28,42 @@ def _cache_dir():
 
 
 def _build(src, out):
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           src, "-o", out]
-    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-    if proc.returncode != 0:
-        raise RuntimeError("native build failed:\n%s" % proc.stderr[-2000:])
+    """Compile under an flock, into a temp file renamed atomically into
+    place: N launcher workers may import cold-cache simultaneously, and
+    a half-written .so must never be dlopen'd (or truncate a mapping
+    another process already holds)."""
+    import fcntl
+    lock_path = out + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            # another process may have finished the build while we waited
+            if os.path.exists(out) and \
+                    os.path.getmtime(out) >= os.path.getmtime(src):
+                return
+            tmp = "%s.%d.tmp" % (out, os.getpid())
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   "-pthread", src, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+            if proc.returncode != 0:
+                raise RuntimeError("native build failed:\n%s"
+                                   % proc.stderr[-2000:])
+            os.replace(tmp, out)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def available():
+    """Cheap probe: is a current .so already built?  Never compiles --
+    diagnostics (runtime.Features) must not block on g++."""
+    if _LIB is not None:
+        return True
+    if os.environ.get("MXNET_TPU_NATIVE", "1") == "0":
+        return False
+    so = os.path.join(_cache_dir(), "librecordio_native.so")
+    return os.path.exists(so) and \
+        os.path.getmtime(so) >= os.path.getmtime(_SRC)
 
 
 def load():

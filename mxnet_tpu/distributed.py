@@ -1,0 +1,38 @@
+"""Multi-process initialization (reference: the ps-lite bootstrap in
+``src/kvstore/kvstore_dist.h`` + ``tools/launch.py`` env protocol).
+
+One call wires a worker into the ``jax.distributed`` world using the
+environment set by ``tools/launch.py``; after it, ``jax.devices()``
+spans every host's chips and the dist kvstore / sharded train steps
+reduce over ICI/DCN collectives.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def distributed_init(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Initialize the multi-process JAX runtime from arguments or the
+    launcher's environment (MXNET_TPU_COORDINATOR / _NUM_PROCS /
+    _PROC_ID).  No-op when single-process or already initialized."""
+    global _initialized
+    if _initialized:
+        return False
+    coordinator_address = coordinator_address or \
+        os.environ.get("MXNET_TPU_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+    if coordinator_address is None or num_processes <= 1:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True

@@ -210,6 +210,19 @@ class RowSparseNDArray(BaseSparseNDArray):
 # Constructors (reference: sparse.py module functions)
 # ----------------------------------------------------------------------
 
+def _coerce_dense(arg1, dtype):
+    """Dense-input dtype rule, matching ``mx.nd.array``: explicit dtype
+    wins; float64 and non-float inputs become float32 (JAX x64 is off,
+    so a declared float64 would silently disagree with storage)."""
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                       else arg1)
+    if dtype is not None:
+        return dense.astype(dtype)
+    if dense.dtype in (np.float32, np.float16):
+        return dense
+    return dense.astype(np.float32)
+
+
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     """Create a CSRNDArray from (data, indices, indptr) or a dense
     array-like (reference: ``sparse.csr_matrix``)."""
@@ -218,10 +231,7 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         if shape is None:
             raise MXNetError("shape required with (data, indices, indptr)")
         return CSRNDArray(data, indices, indptr, shape, dtype, ctx)
-    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
-                       else arg1)
-    if dtype is not None:
-        dense = dense.astype(dtype)
+    dense = _coerce_dense(arg1, dtype)
     if dense.ndim != 2:
         raise MXNetError("csr_matrix needs a 2-D input")
     mask = dense != 0
@@ -246,12 +256,11 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
             nrows = int(idx.max()) + 1 if idx.size else 0
             shape = (nrows,) + tuple(data.shape[1:])
         return RowSparseNDArray(data, indices, shape, dtype, ctx)
-    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
-                       else arg1, dtype or np.float32)
+    dense = _coerce_dense(arg1, dtype)
     live = np.nonzero((dense != 0).reshape(dense.shape[0], -1)
                       .any(axis=1))[0].astype(np.int32)
     return RowSparseNDArray(dense[live], live, dense.shape,
-                            dtype or dense.dtype, ctx)
+                            dense.dtype, ctx)
 
 
 def array(source, ctx=None, dtype=None):

@@ -35,7 +35,8 @@ def _detect():
         "BF16": True,           # native MXU dtype
         "INT64_TENSOR_SIZE": True,
         "SIGNAL_HANDLER": True,
-        "NATIVE_RECORDIO": _try_import("mxnet_tpu._native_check"),
+        # cheap probe -- must not trigger a blocking g++ build
+        "NATIVE_RECORDIO": _native_built(),
         "DIST_KVSTORE": True,   # jax.distributed + collectives
         "OPENMP": False,
         "F16C": True,
@@ -48,6 +49,14 @@ def _try_import(mod):
     try:
         importlib.import_module(mod)
         return True
+    except Exception:
+        return False
+
+
+def _native_built():
+    try:
+        from ._native import available
+        return available()
     except Exception:
         return False
 

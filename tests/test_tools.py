@@ -1,0 +1,65 @@
+"""Tools tests: im2rec, launch, opperf (reference: ``tools/`` +
+``benchmark/opperf``)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_images(root):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        for i in range(4):
+            Image.fromarray(
+                rng.randint(0, 255, (24, 30, 3), dtype=np.uint8)).save(
+                os.path.join(root, cls, "img%d.jpg" % i))
+
+
+def test_im2rec_list_and_pack(tmp_path):
+    root = str(tmp_path / "imgs")
+    prefix = str(tmp_path / "ds")
+    _make_images(root)
+    from tools import im2rec
+    im2rec.main([prefix, root, "--list"])
+    assert os.path.exists(prefix + ".lst")
+    im2rec.main([prefix + ".lst", root, "--resize", "16",
+                 "--center-crop"])
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "r")
+    assert len(rec.keys) == 8
+    hdr, img = recordio.unpack_img(rec.read_idx(rec.keys[0]))
+    assert img.shape == (16, 16, 3)
+    assert hdr.label in (0.0, 1.0)
+
+
+def test_launch_local_env():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, "-c",
+         "import os; print(os.environ['MXNET_TPU_PROC_ID'],"
+         "os.environ['MXNET_TPU_NUM_PROCS'])"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    ranks = sorted(line.split()[0] for line in
+                   out.stdout.strip().splitlines())
+    assert ranks == ["0", "1"]
+
+
+def test_opperf_runs():
+    from benchmark import opperf
+    results = opperf.run(ops=["relu", "dot"], warmup=1, runs=2)
+    by_op = {r["op"]: r for r in results}
+    assert "avg_us" in by_op["relu"] and "avg_us" in by_op["dot"]
+
+
+def test_distributed_init_noop_single_process(monkeypatch):
+    import mxnet_tpu as mx
+    monkeypatch.delenv("MXNET_TPU_COORDINATOR", raising=False)
+    assert mx.distributed_init() is False

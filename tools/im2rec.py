@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""im2rec: build .lst / .rec image datasets (reference:
+``tools/im2rec.py``).
+
+Two phases, same CLI shape as the reference:
+
+1. ``--list``: walk an image directory, assign integer labels per
+   subdirectory, write ``prefix.lst`` ("index\\tlabel\\trelpath"), with
+   optional train/val split and shuffling.
+2. default: read ``prefix.lst`` and pack each image into
+   ``prefix.rec`` + ``prefix.idx`` via the recordio engine (native C++
+   fast path when available), resizing/re-encoding on the fly.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root):
+    cat = {}
+    out = []
+    for path, _dirs, files in sorted(os.walk(root, followlinks=True)):
+        for name in sorted(files):
+            if os.path.splitext(name)[1].lower() not in _EXTS:
+                continue
+            label_dir = os.path.relpath(path, root).split(os.sep)[0]
+            if label_dir not in cat:
+                cat[label_dir] = len(cat)
+            out.append((os.path.relpath(os.path.join(path, name), root),
+                        cat[label_dir]))
+    return out, cat
+
+
+def write_lst(fname, items):
+    with open(fname, "w") as f:
+        for i, (rel, label) in enumerate(items):
+            f.write("%d\t%f\t%s\n" % (i, float(label), rel))
+
+
+def read_lst(fname):
+    with open(fname) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 3:
+                yield int(parts[0]), float(parts[1]), parts[2]
+
+
+def make_lists(args):
+    items, cat = list_images(args.root)
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(items)
+    n_val = int(len(items) * args.test_ratio)
+    if n_val:
+        write_lst(args.prefix + "_val.lst", items[:n_val])
+        write_lst(args.prefix + "_train.lst", items[n_val:])
+    else:
+        write_lst(args.prefix + ".lst", items)
+    print("categories:", {v: k for k, v in cat.items()})
+
+
+def _load_and_encode(path, args):
+    from PIL import Image
+    img = Image.open(path)
+    img = img.convert("L" if args.color == 0 else "RGB")
+    if args.resize:
+        w, h = img.size
+        scale = args.resize / min(w, h)
+        img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        left, top = (w - s) // 2, (h - s) // 2
+        img = img.crop((left, top, left + s, top + s))
+    import io as _io
+    buf = _io.BytesIO()
+    if args.encoding in (".jpg", ".jpeg"):
+        img.save(buf, "JPEG", quality=args.quality)
+    else:
+        img.save(buf, "PNG")
+    return buf.getvalue()
+
+
+def make_record(args, lst_file):
+    prefix = os.path.splitext(lst_file)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, label, rel in read_lst(lst_file):
+        path = os.path.join(args.root, rel)
+        try:
+            payload = _load_and_encode(path, args)
+        except Exception as e:
+            print("skip %s: %s" % (rel, e), file=sys.stderr)
+            continue
+        header = recordio.IRHeader(0, label, idx, 0)
+        rec.write_idx(idx, recordio.pack(header, payload))
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    rec.close()
+    print("wrote %s.rec (%d records)" % (prefix, count))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix (or .lst path to pack)")
+    p.add_argument("root", help="image directory root")
+    p.add_argument("--list", action="store_true",
+                   help="create .lst instead of packing .rec")
+    p.add_argument("--shuffle", type=int, default=1)
+    p.add_argument("--test-ratio", type=float, default=0.0)
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--center-crop", action="store_true")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg")
+    p.add_argument("--color", type=int, default=1, choices=[0, 1])
+    args = p.parse_args(argv)
+    if args.list:
+        make_lists(args)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        make_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()

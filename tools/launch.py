@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Distributed launcher (reference: ``tools/launch.py`` over dmlc-core
+trackers).
+
+TPU-native redesign: there are no parameter-server/scheduler roles --
+every worker is a ``jax.distributed`` process and gradient reduction is
+an XLA collective over ICI/DCN (see ``mxnet_tpu/kvstore.py``).  The
+launcher therefore only has to start N identical processes with the
+coordinator's address and each process's index:
+
+  local mode:   ``launch.py -n 4 python train.py``      (one host)
+  ssh mode:     ``launch.py -n 8 -H hostfile python train.py``
+
+Each worker gets MXNET_TPU_COORDINATOR / MXNET_TPU_NUM_PROCS /
+MXNET_TPU_PROC_ID; ``mxnet_tpu.distributed_init()`` (or user code) maps
+them onto ``jax.distributed.initialize``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, command):
+    coord = "127.0.0.1:%d" % _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXNET_TPU_COORDINATOR": coord,
+            "MXNET_TPU_NUM_PROCS": str(args.num_workers),
+            "MXNET_TPU_PROC_ID": str(rank),
+            # legacy names some scripts read
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(args, command):
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()
+                 and not h.startswith("#")]
+    if len(hosts) < args.num_workers:
+        # round-robin workers over hosts
+        hosts = [hosts[i % len(hosts)] for i in range(args.num_workers)]
+    # per-job coordinator port: a fixed port would collide across jobs
+    # (or a restart racing its predecessor's TIME_WAIT socket)
+    port = args.port or (40000 + os.getpid() % 20000)
+    coord = "%s:%d" % (hosts[0].split(":")[0], port)
+    procs = []
+    cwd = os.getcwd()
+    for rank in range(args.num_workers):
+        host = hosts[rank].split(":")[0]
+        envs = " ".join("%s=%s" % kv for kv in [
+            ("MXNET_TPU_COORDINATOR", coord),
+            ("MXNET_TPU_NUM_PROCS", str(args.num_workers)),
+            ("MXNET_TPU_PROC_ID", str(rank)),
+        ])
+        remote = "cd %s && env %s %s" % (
+            shlex.quote(cwd), envs, " ".join(map(shlex.quote, command)))
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("-H", "--hostfile", default=None,
+                   help="one host per line; omit for single-host local")
+    p.add_argument("--port", type=int, default=0,
+                   help="coordinator port for ssh mode (default: derived "
+                        "per job)")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    if args.hostfile:
+        return launch_ssh(args, args.command)
+    return launch_local(args, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
