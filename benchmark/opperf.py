@@ -63,6 +63,7 @@ def run(ops=None, warmup=5, runs=50, shape=(64, 64)):
     names = ops or sorted(_CURATED)
     for name in names:
         if name not in OP_REGISTRY:
+            results.append({"op": name, "error": "unknown op"})
             continue
         spec = _CURATED.get(name)
         if spec is not None:
@@ -72,6 +73,8 @@ def run(ops=None, warmup=5, runs=50, shape=(64, 64)):
         elif name in _BINARY:
             args, kwargs = [x, x], {}
         else:
+            results.append({"op": name,
+                            "skipped": "no input synthesizer"})
             continue
         fn = getattr(mx.nd, name)
         try:

@@ -55,15 +55,21 @@ def _build(src, out):
 
 
 def available():
-    """Cheap probe: is a current .so already built?  Never compiles --
-    diagnostics (runtime.Features) must not block on g++."""
+    """Cheap probe: is a current .so already built AND loadable?  Never
+    compiles -- diagnostics (runtime.Features) must not block on g++."""
     if _LIB is not None:
         return True
     if os.environ.get("MXNET_TPU_NATIVE", "1") == "0":
         return False
     so = os.path.join(_cache_dir(), "librecordio_native.so")
-    return os.path.exists(so) and \
-        os.path.getmtime(so) >= os.path.getmtime(_SRC)
+    if not (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+        return False
+    try:
+        ctypes.CDLL(so)   # a stale half-written .so must not report ✔
+        return True
+    except OSError:
+        return False
 
 
 def load():

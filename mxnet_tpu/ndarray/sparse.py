@@ -41,7 +41,12 @@ class BaseSparseNDArray:
 
     def __init__(self, shape, dtype, ctx):
         self.shape = tuple(shape)
-        self.dtype = np.dtype(dtype)
+        dtype = np.dtype(dtype)
+        if dtype == np.float64:
+            # JAX x64 is off: declaring float64 would silently disagree
+            # with float32 storage, so normalize at the type boundary
+            dtype = np.dtype(np.float32)
+        self.dtype = dtype
         self._ctx = ctx
 
     @property

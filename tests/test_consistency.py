@@ -1,0 +1,61 @@
+"""CPU-vs-TPU op consistency sweep (reference:
+``tests/python/gpu/test_operator_gpu.py :: check_consistency``).
+
+Runs a representative op set on every available backend and
+cross-compares.  With only CPU visible this degenerates to a smoke run;
+with the TPU attached (the normal driver environment) it is a real
+cross-device numeric check.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+_R = np.random.RandomState(0)
+
+
+def _x(*shape):
+    return _R.rand(*shape).astype(np.float32) + 0.5
+
+
+SWEEP = [
+    ("relu", [_x(8, 16)], {}),
+    ("sigmoid", [_x(8, 16)], {}),
+    ("tanh", [_x(8, 16)], {}),
+    ("exp", [_x(8, 16)], {}),
+    ("log", [_x(8, 16)], {}),
+    ("sqrt", [_x(8, 16)], {}),
+    ("softmax", [_x(8, 16)], {}),
+    ("log_softmax", [_x(8, 16)], {}),
+    ("sum", [_x(8, 16)], {"axis": 1}),
+    ("mean", [_x(8, 16)], {}),
+    ("max", [_x(8, 16)], {"axis": 0}),
+    ("argmax", [_x(8, 16)], {"axis": 1}),
+    ("elemwise_add", [_x(4, 4), _x(4, 4)], {}),
+    ("elemwise_mul", [_x(4, 4), _x(4, 4)], {}),
+    ("broadcast_add", [_x(4, 1), _x(1, 4)], {}),
+    ("dot", [_x(16, 32), _x(32, 8)], {}),
+    ("batch_dot", [_x(4, 8, 16), _x(4, 16, 8)], {}),
+    ("transpose", [_x(3, 5)], {}),
+    ("clip", [_x(8, 8)], {"a_min": 0.6, "a_max": 1.2}),
+    ("_plus_scalar", [_x(8,)], {"scalar": 2.0}),
+    ("_power_scalar", [_x(8,)], {"scalar": 2.0}),
+    ("FullyConnected", [_x(8, 32), _x(16, 32), np.zeros(16, np.float32)],
+     {"num_hidden": 16}),
+    ("Convolution", [_x(2, 3, 8, 8), _x(4, 3, 3, 3),
+                     np.zeros(4, np.float32)],
+     {"num_filter": 4, "kernel": (3, 3), "pad": (1, 1)}),
+    ("Pooling", [_x(2, 3, 8, 8)],
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+    ("LayerNorm", [_x(8, 32), np.ones(32, np.float32),
+                   np.zeros(32, np.float32)], {}),
+    ("Embedding", [np.array([[0, 1], [2, 3]], np.float32), _x(8, 4)],
+     {"input_dim": 8, "output_dim": 4}),
+]
+
+
+@pytest.mark.parametrize("name,inputs,params",
+                         SWEEP, ids=[s[0] for s in SWEEP])
+def test_op_consistency(name, inputs, params):
+    check_consistency(name, inputs, params)

@@ -1,0 +1,76 @@
+"""Error-propagation tests (reference:
+``tests/python/unittest/test_exc_handling.py``): errors surface with
+clear types/messages at the call or sync point, never silently."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_unknown_op_kwarg():
+    with pytest.raises(mx.MXNetError, match="unknown argument"):
+        mx.nd.relu(mx.nd.ones((2,)), bogus_flag=1)
+
+
+def test_shape_mismatch_surfaces():
+    with pytest.raises(Exception):
+        mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5))).asnumpy()
+
+
+def test_backward_outside_record():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    y = x * 2  # not recorded
+    with pytest.raises(mx.MXNetError, match="record"):
+        y.backward()
+
+
+def test_double_backward_without_retain():
+    x = mx.nd.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    with pytest.raises(mx.MXNetError, match="retain"):
+        y.backward()
+
+
+def test_inplace_write_on_tracked_array():
+    x = mx.nd.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError, match="in-place"):
+            y += 1
+
+
+def test_waitall_does_not_swallow():
+    """waitall() is a sync point, not an exception sink: work queued
+    before it still raises there or earlier, and waitall itself never
+    masks failures (reference contract: Engine::WaitForAll rethrows)."""
+    ok = mx.nd.ones((4,)) * 2
+    mx.nd.waitall()
+    np.testing.assert_allclose(ok.asnumpy(), np.full(4, 2.0))
+    with pytest.raises(Exception):
+        # invalid reshape: surfaces as an exception, not a silent pass
+        bad = mx.nd.reshape(mx.nd.ones((4,)), shape=(3, 5))
+        mx.nd.waitall()
+        bad.asnumpy()
+
+
+def test_uninitialized_parameter_access():
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(4)
+    with pytest.raises(Exception):
+        net(mx.nd.ones((2, 3)))  # never initialized
+
+
+def test_module_errors():
+    s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4)
+    mod = mx.mod.Module(mx.sym.SoftmaxOutput(s, name="softmax"))
+    with pytest.raises(AssertionError):
+        mod.forward(mx.io.DataBatch(data=[mx.nd.ones((2, 3))]))
+    with pytest.raises(mx.MXNetError):
+        mx.mod.Module(mx.sym.SoftmaxOutput(s, name="softmax"),
+                      data_names=("wrong_name",))

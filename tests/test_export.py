@@ -1,0 +1,71 @@
+"""Export / SymbolBlock round-trip tests (reference:
+``test_gluon.py :: test_symbol_block`` + ``test_export``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, kernel_size=3, padding=1,
+                            activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    return net
+
+
+def test_export_symbolblock_roundtrip(tmp_path):
+    mx.random.seed(0)
+    net = _net()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(2, 3, 8, 8).astype(np.float32))
+    want = net(x).asnumpy()
+
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+
+    loaded = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                       prefix + "-0000.params")
+    got = loaded(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_exported_json_loads_as_module(tmp_path):
+    """The exported -symbol.json + .params follow the reference
+    checkpoint convention, so Module.load consumes them directly."""
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(1)
+                    .randn(4, 6).astype(np.float32))
+    want = net(x).asnumpy()
+
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (4, 6))], for_training=False)
+    mod.init_params(arg_params=arg_params, aux_params=aux_params)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_symbol_json_schema(tmp_path):
+    import json
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    j = json.loads(out.tojson())
+    assert set(j) >= {"nodes", "arg_nodes", "heads"}
+    ops = [n["op"] for n in j["nodes"]]
+    assert "null" in ops and "FullyConnected" in ops
+    # round trip through load_json
+    s2 = mx.sym.load_json(out.tojson())
+    assert s2.list_arguments() == out.list_arguments()

@@ -1,0 +1,99 @@
+"""Initializer distribution tests + model-zoo forward-shape tests
+(reference: ``test_init.py`` / ``test_gluon_model_zoo.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _init_arr(init, shape=(64, 64), name="weight"):
+    arr = mx.nd.zeros(shape)
+    init(mx.init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_init_arr(mx.init.Zero()) == 0).all()
+    assert (_init_arr(mx.init.One()) == 1).all()
+    assert (_init_arr(mx.init.Constant(2.5)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    u = _init_arr(mx.init.Uniform(0.3))
+    assert u.min() >= -0.3 and u.max() <= 0.3 and u.std() > 0.05
+    n = _init_arr(mx.init.Normal(0.5), shape=(128, 128))
+    assert abs(n.std() - 0.5) < 0.05
+
+
+def test_xavier_magnitude():
+    x = _init_arr(mx.init.Xavier(factor_type="avg", magnitude=3),
+                  shape=(100, 100))
+    bound = np.sqrt(3.0 / 100)
+    assert abs(x).max() <= bound + 1e-6
+    assert x.std() > bound / 4
+
+
+def test_orthogonal():
+    w = _init_arr(mx.init.Orthogonal(scale=1.0), shape=(32, 32))
+    np.testing.assert_allclose(w @ w.T, np.eye(32), atol=1e-4)
+    # reference default scale is sqrt(2): W W^T = 2 I
+    w2 = _init_arr(mx.init.Orthogonal(), shape=(16, 16))
+    np.testing.assert_allclose(w2 @ w2.T, np.eye(16) * 1.414 ** 2,
+                               atol=1e-3)
+
+
+def test_name_dispatch():
+    """gamma/beta/bias/moving stats get their canonical values."""
+    init = mx.init.Xavier()
+    assert (_init_arr(init, (8,), "bn_gamma") == 1).all()
+    assert (_init_arr(init, (8,), "bn_beta") == 0).all()
+    assert (_init_arr(init, (8,), "fc_bias") == 0).all()
+    assert (_init_arr(init, (8,), "bn_moving_mean") == 0).all()
+    assert (_init_arr(init, (8,), "bn_moving_var") == 1).all()
+
+
+def test_mixed_initializer():
+    # note: names like *_gamma dispatch to the Initializer's gamma rule,
+    # so Mixed patterns are exercised with plain weight-like names
+    mixed = mx.init.Mixed([".*special", ".*"],
+                          [mx.init.Constant(3.0), mx.init.Zero()])
+    assert (_init_arr(mixed, (4,), "x_special") == 3.0).all()
+    assert (_init_arr(mixed, (4,), "weight") == 0.0).all()
+
+
+# ----------------------------------------------------------------------
+# model zoo forward shapes
+# ----------------------------------------------------------------------
+
+def test_get_model_registry():
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    for name in ("resnet18_v1", "resnet50_v1", "vgg11", "alexnet",
+                 "squeezenet1.0", "mobilenet1.0", "densenet121"):
+        net = get_model(name, classes=10)
+        assert net is not None
+    with pytest.raises(Exception):
+        get_model("not_a_model")
+
+
+@pytest.mark.parametrize("name,size", [("resnet18_v1", 32),
+                                       ("mobilenet0.25", 32)])
+def test_zoo_forward_shape(name, size):
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    mx.random.seed(0)
+    net = get_model(name, classes=7)
+    net.initialize()
+    x = mx.nd.zeros((2, 3, size, size))
+    out = net(x)
+    assert out.shape == (2, 7)
+
+
+def test_resnet50_forward_shape():
+    """The BASELINE config-2 model builds and runs (reference:
+    ``test_gluon_model_zoo.py :: test_models``)."""
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize()
+    out = net(mx.nd.zeros((1, 3, 224, 224)))
+    assert out.shape == (1, 1000)

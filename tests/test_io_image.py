@@ -1,0 +1,109 @@
+"""Data-pipeline tests: io iterators, image augmenters, record
+iterators (reference: ``tests/python/unittest/test_io.py`` /
+``test_image.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, io, recordio
+
+
+def _make_rec(tmp_path, n=12, hw=(32, 36)):
+    prefix = str(tmp_path / "ds")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,), dtype=np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    rec.close()
+    return prefix
+
+
+def test_ndarray_iter_pad_and_discard():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = io.NDArrayIter(x, x[:, 0], batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = io.NDArrayIter(x, x[:, 0], batch_size=4,
+                         last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_resize_iter():
+    x = np.zeros((8, 2), np.float32)
+    base = io.NDArrayIter(x, batch_size=4)
+    it = io.ResizeIter(base, size=5)
+    assert len(list(it)) == 5
+
+
+def test_image_record_iter(tmp_path):
+    prefix = _make_rec(tmp_path)
+    it = io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            data_shape=(3, 24, 24), batch_size=4,
+                            mean_r=128, mean_g=128, mean_b=128,
+                            preprocess_threads=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape[0] == 4
+    # mean-normalized floats, not raw uint8
+    assert batch.data[0].asnumpy().min() < 0
+
+
+def test_image_iter_sharding(tmp_path):
+    prefix = _make_rec(tmp_path)
+    parts = []
+    for pi in range(2):
+        it = image.ImageIter(4, (3, 24, 24), path_imgrec=prefix + ".rec",
+                             num_parts=2, part_index=pi)
+        labels = []
+        for b in it:
+            labels.extend(b.label[0].asnumpy().tolist())
+        parts.append(labels)
+    # disjoint shards covering different records
+    assert len(parts[0]) + len(parts[1]) >= 8
+
+
+def test_augmenters():
+    rng = np.random.RandomState(0)
+    img = mx.nd.array(rng.randint(0, 255, (40, 50, 3),
+                                  dtype=np.uint8).astype(np.float32))
+    out = image.ResizeAug(32)(img)
+    assert min(out.shape[:2]) == 32
+    out = image.CenterCropAug((24, 24))(img)
+    assert out.shape[:2] == (24, 24)
+    out = image.RandomCropAug((24, 24))(img)
+    assert out.shape[:2] == (24, 24)
+    flipped = image.HorizontalFlipAug(1.0)(img)
+    np.testing.assert_allclose(flipped.asnumpy(),
+                               img.asnumpy()[:, ::-1])
+    jit = image.ColorJitterAug(0.3, 0.3, 0.3)(img)
+    assert jit.shape == img.shape
+    auglist = image.CreateAugmenter((3, 24, 24), resize=32,
+                                    rand_mirror=True, brightness=0.1)
+    assert len(auglist) >= 4
+
+
+def test_imdecode_imresize():
+    import io as _io
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (20, 30, 3), dtype=np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    img = image.imdecode(buf.getvalue())
+    assert img.shape == (20, 30, 3)
+    small = image.imresize(img, 10, 8)
+    assert small.shape[:2] == (8, 10)
+
+
+def test_csv_iter(tmp_path):
+    path = str(tmp_path / "d.csv")
+    np.savetxt(path, np.arange(12).reshape(4, 3), delimiter=",")
+    it = io.CSVIter(data_csv=path, data_shape=(3,), batch_size=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3)

@@ -1,0 +1,88 @@
+"""KVStore tests (reference: ``tests/python/unittest/test_kvstore.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_init_push_pull_aggregation():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    # push without optimizer accumulates; pull drains
+    kv.push(3, [mx.nd.ones((2, 3)), mx.nd.ones((2, 3)) * 2])
+    out = mx.nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 3.0))
+    # after drain, pull returns the stored value
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+
+
+def test_pushpull_allreduce_semantics():
+    kv = mx.kv.create("device")
+    kv.init("g", mx.nd.zeros((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pushpull("g", [mx.nd.ones((4,)), mx.nd.ones((4,))], out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 2.0))
+
+
+def test_optimizer_on_store():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.0))
+    kv.push("w", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(3, 0.9), rtol=1e-6)
+
+
+def test_gradient_compression_error_feedback():
+    """2-bit compression quantizes pushes to {-t, 0, +t} and carries the
+    residual (reference: ``gradient_compression.cc``)."""
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = mx.nd.array(np.array([0.3, 0.7, -0.9, 0.0], np.float32))
+    out = mx.nd.zeros((4,))
+    kv.pushpull("w", g, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, -0.5, 0.0])
+    # second identical push: residual (0.3, 0.2, -0.4, 0) + g crosses
+    # the threshold for the first element now
+    kv.pushpull("w", g, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, 0.5, -0.5, 0.0])
+
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_optimizer_state_save_load(tmp_path):
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    for _ in range(3):
+        kv.push("w", mx.nd.ones((3,)))
+    fname = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(fname)
+
+    kv2 = mx.kv.create("local")
+    kv2.init("w", mx.nd.ones((3,)))
+    kv2.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv2.load_optimizer_states(fname)
+    s1 = kv._updater.states["w"]
+    s2 = kv2._updater.states["w"]
+    np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy())
+
+
+def test_uninitialized_key_raises():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push("nope", mx.nd.ones((2,)))
+    with pytest.raises(mx.MXNetError):
+        kv.pull("nope", out=mx.nd.zeros((2,)))
+
+
+def test_rank_and_type():
+    kv = mx.kv.create("local")
+    assert kv.rank == 0 and kv.num_workers == 1
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("bogus_type")

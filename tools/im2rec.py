@@ -128,9 +128,17 @@ def main(argv=None):
     if args.list:
         make_lists(args)
     else:
-        lst = args.prefix if args.prefix.endswith(".lst") \
-            else args.prefix + ".lst"
-        make_record(args, lst)
+        import glob
+        if args.prefix.endswith(".lst"):
+            lsts = [args.prefix]
+        else:
+            # a --test-ratio split produces prefix_train/_val.lst; pack
+            # every matching list like the reference tool
+            lsts = sorted(glob.glob(args.prefix + "*.lst"))
+        if not lsts:
+            p.error("no .lst file found for prefix %r" % args.prefix)
+        for lst in lsts:
+            make_record(args, lst)
 
 
 if __name__ == "__main__":

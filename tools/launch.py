@@ -33,6 +33,34 @@ def _free_port():
     return port
 
 
+def _wait_all(procs):
+    """Wait for every worker, failing FAST: the first nonzero exit
+    terminates the survivors (a dead peer would otherwise wedge the
+    rest inside jax.distributed collectives); Ctrl-C tears all down."""
+    import time
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                if rc != 0:
+                    for q in procs:
+                        q.terminate()
+                    for q in procs:
+                        q.wait()
+                    return rc
+            time.sleep(0.1)
+        return 0
+    except KeyboardInterrupt:
+        for q in procs:
+            q.terminate()
+        for q in procs:
+            q.wait()
+        raise
+
+
 def launch_local(args, command):
     coord = "127.0.0.1:%d" % _free_port()
     procs = []
@@ -47,10 +75,7 @@ def launch_local(args, command):
             "DMLC_NUM_WORKER": str(args.num_workers),
         })
         procs.append(subprocess.Popen(command, env=env))
-    rc = 0
-    for p in procs:
-        rc |= p.wait()
-    return rc
+    return _wait_all(procs)
 
 
 def launch_ssh(args, command):
@@ -76,11 +101,11 @@ def launch_ssh(args, command):
         remote = "cd %s && env %s %s" % (
             shlex.quote(cwd), envs, " ".join(map(shlex.quote, command)))
         procs.append(subprocess.Popen(
-            ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]))
-    rc = 0
-    for p in procs:
-        rc |= p.wait()
-    return rc
+            ["ssh", "-o", "StrictHostKeyChecking=no", "-tt", host,
+             remote]))
+    # -tt allocates a tty so terminating the ssh client also kills the
+    # remote command instead of orphaning it
+    return _wait_all(procs)
 
 
 def main(argv=None):
