@@ -219,9 +219,12 @@ class CTCLoss(Loss):
         logp = jax.nn.log_softmax(logits, axis=-1)
         blank = 0
         labels_i = labels.astype(jnp.int32)
-        # extended label seq: blank, l1, blank, l2, ... blank  (len 2L+1)
+        # extended label seq: blank, l1, blank, l2, ... blank  (len 2L+1);
+        # negative labels are padding (reference convention) and map to
+        # blank so they cannot emit
         ext = jnp.full((B, 2 * L + 1), blank, jnp.int32)
-        ext = ext.at[:, 1::2].set(labels_i)
+        ext = ext.at[:, 1::2].set(jnp.where(labels_i >= 0, labels_i,
+                                            blank))
         S = 2 * L + 1
         neg_inf = -1e30
         alpha0 = jnp.full((B, S), neg_inf)
@@ -262,11 +265,16 @@ class CTCLoss(Loss):
             ll = (label_lengths._data if isinstance(label_lengths, NDArray)
                   else label_lengths).astype(jnp.int32)
         else:
-            ll = jnp.full((B,), L, jnp.int32)
+            # infer per-sample length from -1 padding (reference
+            # behavior when no explicit label_lengths is given)
+            ll = jnp.sum(labels_i >= 0, axis=1).astype(jnp.int32)
         endpos = 2 * ll  # index of final blank
         last1 = jnp.take_along_axis(alpha, endpos[:, None], axis=1)[:, 0]
         last2 = jnp.take_along_axis(alpha, jnp.maximum(endpos - 1, 0)[:, None],
                                     axis=1)[:, 0]
+        # an empty label sequence has only the all-blank path: the
+        # endpos-1 clamp would read alpha[:,0] twice (double count)
+        last2 = jnp.where(ll == 0, neg_inf, last2)
         m = jnp.maximum(last1, last2)
         ll_total = m + jnp.log(jnp.exp(last1 - m) + jnp.exp(last2 - m))
         from ..ndarray import from_jax

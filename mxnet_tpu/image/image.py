@@ -222,16 +222,20 @@ class ImageIter:
         return self
 
     def __next__(self):
-        if self._cursor + self.batch_size > len(self._keys):
+        if self._cursor >= len(self._keys):
             raise StopIteration
+        # final partial batch is padded by wrapping to the start
+        # (reference behavior: batch.pad records the overhang)
+        pad = max(0, self._cursor + self.batch_size - len(self._keys))
         datas, labels = [], []
         for i in range(self.batch_size):
-            a, l = self._read_one(self._order[self._cursor + i])
+            pos = (self._cursor + i) % len(self._keys)
+            a, l = self._read_one(self._order[pos])
             datas.append(a)
             labels.append(np.atleast_1d(np.asarray(l, np.float32))[0])
         self._cursor += self.batch_size
         from ..io import DataBatch
         return DataBatch(data=[array(np.stack(datas))],
-                         label=[array(np.asarray(labels))])
+                         label=[array(np.asarray(labels))], pad=pad)
 
     next = __next__

@@ -7,6 +7,7 @@ from .ndarray import (NDArray, array, arange, concat, concatenate, empty,
 from . import register as _register
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import contrib  # noqa: F401
 
 _register.populate(_sys.modules[__name__].__dict__)
 

@@ -1,0 +1,194 @@
+"""Contrib namespace: control-flow operators + contrib op re-exports
+(reference: ``python/mxnet/ndarray/contrib.py`` over
+``src/operator/control_flow.cc``).
+
+Control flow is where TPU-first design diverges hardest from the
+reference: instead of an engine interpreting per-iteration subgraphs,
+``foreach``/``while_loop``/``cond`` trace the Python body ONCE and lower
+to ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` -- single compiled
+programs with no per-step dispatch.  Gradients flow through the explicit
+``data``/``loop_vars`` operands (the tape records one node for the whole
+construct); arrays merely captured by the body closure are constants to
+the gradient, so thread weights through the state if they must train.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import autograd
+from ..base import MXNetError
+from .ndarray import NDArray
+
+
+def _aslist(x):
+    if x is None:
+        return [], True
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _unlist(lst, single):
+    if single:
+        return lst[0] if lst else None
+    return lst
+
+
+class _FlowOp:
+    """Just enough op-shape for the tape node naming."""
+    def __init__(self, name):
+        self.name = name
+        self.num_diff_outputs = None
+
+
+def _dispatch(name, pure_fn, inputs):
+    """Run a pure multi-in/multi-out function with tape integration,
+    mirroring ``invoke``'s recording semantics for a fused construct."""
+    from .ndarray import _wrap_outputs
+    vals = tuple(a._data for a in inputs)
+    recording = autograd.is_recording() and \
+        any(a._is_tracked() for a in inputs)
+    if recording:
+        raw, vjp_fn = jax.vjp(pure_fn, *vals)
+        return _wrap_outputs(_FlowOp(name), list(raw), list(inputs),
+                             vjp_fn, {})
+    return _wrap_outputs(_FlowOp(name), list(pure_fn(*vals)), None, None,
+                         {})
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over the leading axis of ``data`` (reference:
+    ``contrib.foreach``): ``body(data_t, states) -> (out_t, states)``;
+    returns (stacked outputs, final states).  Lowers to ONE compiled
+    ``lax.scan`` -- the whole loop is a single XLA while op on TPU."""
+    datas, single_data = _aslist(data)
+    states, single_state = _aslist(init_states)
+    n_data = len(datas)
+    out_struct = {}
+
+    def pure(*vals):
+        dvals = vals[:n_data]
+        svals = vals[n_data:]
+
+        def step(carry, xs):
+            with autograd.pause():
+                st = [NDArray(c) for c in carry]
+                xnd = [NDArray(x) for x in xs]
+                out, new_st = body(_unlist(xnd, single_data),
+                                   _unlist(st, single_state))
+            outs, out_single = _aslist(out)
+            news, new_single = _aslist(new_st)
+            out_struct["out_single"] = out_single
+            return tuple(n._data for n in news), \
+                tuple(o._data for o in outs)
+
+        carry, ys = lax.scan(step, tuple(svals), tuple(dvals))
+        return tuple(ys) + tuple(carry)
+
+    n_out = None
+    outs = _dispatch("foreach", pure, datas + states)
+    outs = outs if isinstance(outs, list) else [outs]
+    n_out = len(outs) - len(states)
+    stacked = outs[:n_out]
+    finals = outs[n_out:]
+    return _unlist(stacked, out_struct.get("out_single", True)), \
+        _unlist(finals, single_state)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Reference: ``contrib.while_loop``.  Static-shape semantics: runs
+    at most ``max_iterations`` steps of a ``lax.scan`` with an active
+    mask (XLA needs a bound); per-step outputs beyond the dynamic stop
+    are zero, matching the reference's padded-output contract."""
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations "
+                         "(static bound for the compiled loop)")
+    vars_, single = _aslist(loop_vars)
+    meta = {}
+
+    def pure(*vals):
+        def step(carry, _):
+            active, vs = carry
+            with autograd.pause():
+                vnd = [NDArray(v) for v in vs]
+                c = cond(*vnd)
+                out, new_vs = func(*vnd)
+            outs, out_single = _aslist(out)
+            news, _ = _aslist(new_vs)
+            meta["out_single"] = out_single
+            c_now = jnp.logical_and(active, c._data.astype(bool)
+                                    .reshape(()))
+            new_vals = tuple(
+                jnp.where(c_now, n._data, v)
+                for n, v in zip(news, vs))
+            step_outs = tuple(
+                jnp.where(c_now, o._data, jnp.zeros_like(o._data))
+                for o in outs)
+            return (c_now, new_vals), step_outs
+
+        (active, final), ys = lax.scan(
+            step, (jnp.asarray(True), tuple(vals)), None,
+            length=int(max_iterations))
+        return tuple(ys) + tuple(final)
+
+    outs = _dispatch("while_loop", pure, vars_)
+    outs = outs if isinstance(outs, list) else [outs]
+    n_out = len(outs) - len(vars_)
+    stacked = outs[:n_out]
+    finals = outs[n_out:]
+    return _unlist(stacked, meta.get("out_single", True)), \
+        _unlist(finals, single)
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Reference: ``contrib.cond``.  Both branches are traced once and
+    compiled into a single ``lax.cond`` -- device-resident branching, no
+    host sync on the predicate."""
+    inputs, _ = _aslist(inputs)
+    meta = {}
+
+    def pure(pval, *vals):
+        def mk(branch):
+            def run(vs):
+                with autograd.pause():
+                    nds = [NDArray(v) for v in vs]
+                    out = branch(*nds)
+                outs, single = _aslist(out)
+                meta["single"] = single
+                return tuple(o._data for o in outs)
+            return run
+        return lax.cond(pval.astype(bool).reshape(()),
+                        mk(then_func), mk(else_func), tuple(vals))
+
+    pred_nd = pred if isinstance(pred, NDArray) else NDArray(
+        jnp.asarray(pred))
+    outs = _dispatch("cond", pure, [pred_nd] + inputs)
+    outs = outs if isinstance(outs, list) else [outs]
+    return _unlist(outs, meta.get("single", True))
+
+
+def _export_contrib_ops():
+    """Expose registered contrib-family ops as ``mx.nd.contrib.*``
+    (reference surfaces them both flat and nested)."""
+    from ..ops.registry import OP_REGISTRY
+    from . import register as _register
+    mod = sys.modules[__name__]
+    wanted = ("box_iou", "box_nms", "ROIAlign", "ROIPooling",
+              "quantize", "quantize_v2", "dequantize", "requantize",
+              "quantized_fully_connected", "CTCLoss", "ctc_loss",
+              "im2col", "col2im", "interleaved_matmul_selfatt_qk",
+              "interleaved_matmul_selfatt_valatt",
+              "interleaved_matmul_encdec_qk",
+              "interleaved_matmul_encdec_valatt", "flash_attention")
+    ns = {}
+    _register.populate(ns)
+    for name in wanted:
+        if name in ns:
+            setattr(mod, name, ns[name])
+
+
+_export_contrib_ops()

@@ -9,3 +9,5 @@ from . import nn  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import transformer  # noqa: F401
+from . import linalg  # noqa: F401
+from . import contrib_ops  # noqa: F401

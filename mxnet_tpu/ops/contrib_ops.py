@@ -1,0 +1,365 @@
+"""Contrib operators: im2col, quantization, boxes/ROI, CTC (reference:
+``src/operator/{im2col,quantization,contrib}``).
+
+TPU notes per family:
+
+- **im2col/col2im** lower to ``lax.conv_general_dilated_patches`` -- the
+  same tiling XLA already uses for convolutions.
+- **quantization** is int8 *simulation* with fp32 scales (quantize /
+  dequantize / requantize + quantized FC).  On TPU the deploy dtype is
+  int8-in-bf16-out through the MXU; these ops carry the reference's
+  calibration API so quantized graphs port over.
+- **boxes** (box_iou, box_nms, ROIPooling, ROIAlign) use static-shape
+  masking -- no dynamic gather shapes, scores are suppressed by writing
+  -1, exactly the reference's output convention.
+- **CTC** exposes the alpha-recursion loss as an *operator* (the layer
+  in ``gluon/loss.py`` wraps it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ----------------------------------------------------------------------
+# im2col / col2im (reference: src/operator/nn/im2col.h)
+# ----------------------------------------------------------------------
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _im2col_impl(data, kernel, stride, dilate, pad):
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilate)
+    ph, pw = _pair(pad)
+    patches = lax.conv_general_dilated_patches(
+        data, (kh, kw), (sh, sw), [(ph, ph), (pw, pw)],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+@register("im2col", args=("data",))
+def _im2col(data, kernel=(3, 3), stride=(1, 1), dilate=(1, 1),
+            pad=(0, 0)):
+    """(N, C, H, W) -> (N, C*kh*kw, L) patches (reference: ``im2col``)."""
+    return _im2col_impl(data, kernel, stride, dilate, pad)
+
+
+@register("col2im", args=("data",))
+def _col2im(data, output_size=(0, 0), kernel=(3, 3), stride=(1, 1),
+            dilate=(1, 1), pad=(0, 0)):
+    """Scatter-add patches back to (N, C, H, W) (reference: ``col2im``);
+    the linear adjoint of im2col, expressed as its vjp so the two stay
+    exact inverses-in-adjoint."""
+    oh, ow = _pair(output_size)
+    kh, kw = _pair(kernel)
+    n = data.shape[0]
+    c = data.shape[1] // (kh * kw)
+
+    def fwd(img):
+        return _im2col_impl(img, (kh, kw), _pair(stride), _pair(dilate),
+                            _pair(pad))
+
+    zero = jnp.zeros((n, c, oh, ow), data.dtype)
+    _, vjp = jax.vjp(fwd, zero)
+    (img,) = vjp(data)
+    return img
+
+
+# ----------------------------------------------------------------------
+# Quantization (reference: src/operator/quantization/*.cc)
+# ----------------------------------------------------------------------
+
+@register("quantize_v2", args=("data",),
+          aliases=("_contrib_quantize_v2",))
+def _quantize_v2(data, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    """fp32 -> int8 + (min, max) calibration range (reference:
+    ``quantize_v2``)."""
+    if min_calib_range is None or max_calib_range is None:
+        amin = jnp.min(data)
+        amax = jnp.max(data)
+    else:
+        amin = jnp.asarray(min_calib_range, jnp.float32)
+        amax = jnp.asarray(max_calib_range, jnp.float32)
+    bound = jnp.maximum(jnp.abs(amin), jnp.abs(amax))
+    scale = 127.0 / jnp.maximum(bound, 1e-20)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -bound, bound
+
+
+@register("quantize", args=("data", "min_range", "max_range"))
+def _quantize(data, min_range, max_range, out_type="int8"):
+    bound = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = 127.0 / jnp.maximum(bound, 1e-20)
+    q = jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+    return q, -bound, bound
+
+
+@register("dequantize", args=("data", "min_range", "max_range"))
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    bound = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    # divisor follows the storage dtype: int8 spans +-127, an int32
+    # accumulator from a quantized matmul spans +-127*127 by convention
+    q_max = 127.0 if data.dtype == jnp.int8 else 127.0 * 127.0
+    return data.astype(jnp.float32) * (bound / q_max)
+
+
+@register("requantize", args=("data", "min_range", "max_range"),
+          aliases=("_contrib_requantize",))
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    """int32 accum -> int8 with a new range (reference: ``requantize``)."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        / (127.0 * 127.0))
+    if min_calib_range is not None:
+        bound = max(abs(float(min_calib_range)),
+                    abs(float(max_calib_range)))
+        bound = jnp.asarray(bound, jnp.float32)
+    else:
+        bound = jnp.maximum(jnp.abs(real).max(), 1e-20)
+    q = jnp.clip(jnp.round(real * (127.0 / bound)), -127, 127) \
+        .astype(jnp.int8)
+    return q, -bound, bound
+
+
+@register("quantized_fully_connected",
+          args=("data", "weight", "bias", "min_data", "max_data",
+                "min_weight", "max_weight", "min_bias", "max_bias"))
+def _quantized_fully_connected(data, weight, bias, min_data, max_data,
+                               min_weight, max_weight, min_bias, max_bias,
+                               num_hidden=0, no_bias=False, flatten=True):
+    """int8 x int8 -> int32 FC (reference:
+    ``quantized_fully_connected``).  On TPU the int8 matmul rides the
+    MXU via int32 accumulation."""
+    x = data.astype(jnp.int32)
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    acc = jax.lax.dot_general(
+        x, weight.astype(jnp.int32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    sd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    if bias is not None and not no_bias:
+        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        scale_ratio = sb / jnp.maximum(sd * sw, 1e-20)
+        acc = acc + jnp.round(
+            bias.astype(jnp.float32) * scale_ratio).astype(jnp.int32)
+    out_bound = 127.0 * 127.0 * sd * sw
+    return acc, -out_bound, out_bound
+
+
+# ----------------------------------------------------------------------
+# Boxes / ROI (reference: src/operator/contrib/{bounding_box,roi_align}.cc,
+# src/operator/roi_pooling.cc)
+# ----------------------------------------------------------------------
+
+def _iou_matrix(a, b, fmt="corner"):
+    if fmt == "center":
+        def to_corner(x):
+            cx, cy, w, h = (x[..., 0], x[..., 1], x[..., 2], x[..., 3])
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+        a, b = to_corner(a), to_corner(b)
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0) * \
+        jnp.maximum(a[..., 3] - a[..., 1], 0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register("box_iou", args=("lhs", "rhs"),
+          aliases=("_contrib_box_iou",))
+def _box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: ``_contrib_box_iou``)."""
+    return _iou_matrix(lhs, rhs, format)
+
+
+@register("box_nms", args=("data",),
+          aliases=("_contrib_box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1, force_suppress=True,
+             in_format="corner", out_format="corner"):
+    """Non-max suppression with static shapes (reference:
+    ``_contrib_box_nms``): suppressed entries get score -1, order is
+    score-sorted, shape is unchanged -- no dynamic output sizes."""
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = batch[:, coord_start:coord_start + 4]
+        order = jnp.argsort(-scores)
+        boxes_s = boxes[order]
+        scores_s = scores[order]
+        n = scores.shape[0]
+        iou = _iou_matrix(boxes_s, boxes_s, in_format)
+
+        def body(i, keep):
+            # suppress j>i overlapping a kept i
+            sup = (iou[i] > overlap_thresh) & \
+                (jnp.arange(n) > i) & keep[i]
+            return keep & ~sup
+        keep = lax.fori_loop(0, n, body, scores_s > valid_thresh)
+        out = batch[order]
+        out = out.at[:, score_index].set(
+            jnp.where(keep, scores_s, -1.0))
+        return out
+    if data.ndim == 2:
+        return one(data)
+    return jax.vmap(one)(data)
+
+
+@register("ROIPooling", args=("data", "rois"))
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI to a fixed grid (reference:
+    ``src/operator/roi_pooling.cc``).  Static shapes: every ROI yields
+    (C, ph, pw) by masked max over the feature map."""
+    ph, pw = _pair(pooled_size)
+    n, c, h, w = data.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = jnp.round(roi[1:5] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        fmap = data[bidx]                       # (C, H, W)
+
+        def cell(py, px):
+            ys0 = y1 + py * bh
+            ys1 = y1 + (py + 1) * bh
+            xs0 = x1 + px * bw
+            xs1 = x1 + (px + 1) * bw
+            my = (ys >= jnp.floor(ys0)) & (ys < jnp.ceil(ys1))
+            mxm = (xs >= jnp.floor(xs0)) & (xs < jnp.ceil(xs1))
+            mask = my[:, None] & mxm[None, :]
+            neg = jnp.full((h, w), -jnp.inf, fmap.dtype)
+            sel = jnp.where(mask[None], fmap, neg[None])
+            out = jnp.max(sel, axis=(1, 2))
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        grid = jnp.stack([jnp.stack([cell(py, px) for px in range(pw)],
+                                    axis=-1) for py in range(ph)], axis=-2)
+        return grid                              # (C, ph, pw)
+    return jax.vmap(one)(rois)
+
+
+@register("ROIAlign", args=("data", "rois"),
+          aliases=("_contrib_ROIAlign",))
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=2):
+    """Bilinear ROI align (reference: ``contrib/roi_align.cc``)."""
+    ph, pw = _pair(pooled_size)
+    n, c, h, w = data.shape
+    sr = max(int(sample_ratio), 1)
+
+    def bilinear(fmap, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        wy1 = y - y0
+        wx1 = x - x0
+        y0i, x0i, y1i, x1i = (a.astype(jnp.int32) for a in
+                              (y0, x0, y1, x1))
+        return (fmap[:, y0i, x0i] * (1 - wy1) * (1 - wx1) +
+                fmap[:, y1i, x0i] * wy1 * (1 - wx1) +
+                fmap[:, y0i, x1i] * (1 - wy1) * wx1 +
+                fmap[:, y1i, x1i] * wy1 * wx1)
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1:5] * spatial_scale
+        bh = jnp.maximum(y2 - y1, 1.0) / ph
+        bw = jnp.maximum(x2 - x1, 1.0) / pw
+        fmap = data[bidx]
+
+        def cell(py, px):
+            acc = 0.0
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + (py + (iy + 0.5) / sr) * bh
+                    x = x1 + (px + (ix + 0.5) / sr) * bw
+                    acc = acc + bilinear(fmap, y, x)
+            return acc / (sr * sr)
+        return jnp.stack([jnp.stack([cell(py, px) for px in range(pw)],
+                                    axis=-1) for py in range(ph)], axis=-2)
+    return jax.vmap(one)(rois)
+
+
+# ----------------------------------------------------------------------
+# CTC as an operator (reference: src/operator/nn/ctc_loss.cc)
+# ----------------------------------------------------------------------
+
+@register("CTCLoss", args=("data", "label"), aliases=("ctc_loss",))
+def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
+    """Connectionist temporal classification loss op over (T, N, C)
+    activations and (N, L) labels (reference: ``CTCLoss``).  The gluon
+    layer (``gluon/loss.py :: CTCLoss``) wraps this with layout/length
+    options; the op itself implements the log-space alpha recursion via
+    ``lax.scan``."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    lab = label.astype(jnp.int32)
+    L = lab.shape[1]
+    # extended label sequence: blank l1 blank l2 ... blank
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    valid = jnp.concatenate(
+        [jnp.ones((N, 1), jnp.bool_),
+         jnp.repeat(lab >= 0, 2, axis=1)], axis=1)[:, :S]
+    ext = jnp.where(valid, ext, blank)
+    label_len = jnp.sum(lab >= 0, axis=1)
+
+    neg_inf = -1e30
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0][jnp.arange(N), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0,
+                  logp[0][jnp.arange(N), ext[:, 1]], neg_inf))
+
+    same = jnp.concatenate(
+        [jnp.zeros((N, 2), jnp.bool_), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, logp_t):
+        a0 = alpha
+        a1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(same, neg_inf, a2)
+        m = jnp.maximum(jnp.maximum(a0, a1), a2)
+        m_safe = jnp.maximum(m, neg_inf)
+        summed = jnp.exp(a0 - m_safe) + jnp.exp(a1 - m_safe) + \
+            jnp.exp(a2 - m_safe)
+        new = m_safe + jnp.log(summed) + \
+            logp_t[jnp.arange(N)[:, None], ext]
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, logp[1:])
+    end = 2 * label_len - 1
+    last_blank = alpha[jnp.arange(N), 2 * label_len]
+    last_label = alpha[jnp.arange(N),
+                       jnp.maximum(end, 0)]
+    # empty label sequence: only the all-blank path exists; the clamped
+    # end index would double-count alpha[:, 0]
+    last_label = jnp.where(label_len == 0, neg_inf, last_label)
+    m = jnp.maximum(last_blank, last_label)
+    ll = m + jnp.log(jnp.exp(last_blank - m) + jnp.exp(last_label - m))
+    return -ll

@@ -1,0 +1,168 @@
+"""Linear-algebra operators (reference: ``src/operator/tensor/la_op.cc``
+-- the ``mx.nd.linalg_*`` family).
+
+All lower onto jax.numpy.linalg / lax.linalg, which XLA maps to the
+MXU-tiled factorization kernels on TPU.  Batch dimensions are supported
+everywhere (leading dims broadcast), matching the reference's batched
+BLAS/LAPACK semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register("linalg_gemm", args=("A", "B", "C"))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    """C' = alpha * op(A) op(B) + beta * C (reference: ``linalg_gemm``)."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", args=("A", "B"))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                  axis=-2):
+    """alpha * op(A) op(B) (reference: ``linalg_gemm2``)."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", args=("A",))
+def _linalg_potrf(A):
+    """Cholesky factor L with A = L L^T (reference: ``linalg_potrf``)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri", args=("A",))
+def _linalg_potri(A):
+    """Inverse from a Cholesky factor: given L, return (L L^T)^-1
+    (reference: ``linalg_potri``)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("linalg_trsm", args=("A", "B"))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B) with triangular A
+    (reference: ``linalg_trsm``)."""
+    solve = jax.scipy.linalg.solve_triangular
+    if rightside:
+        # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+        out = solve(_t(A), _t(alpha * B), lower=not lower,
+                    trans=1 if transpose else 0)
+        return _t(out)
+    return solve(A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm", args=("A", "B"))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    """Triangular matmul op(A) B (reference: ``linalg_trmm``)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = _t(tri)
+    if rightside:
+        return alpha * jnp.matmul(B, tri)
+    return alpha * jnp.matmul(tri, B)
+
+
+@register("linalg_syrk", args=("A",))
+def _linalg_syrk(A, transpose=False, alpha=1.0):
+    """alpha A A^T (or A^T A) (reference: ``linalg_syrk``)."""
+    if transpose:
+        return alpha * jnp.matmul(_t(A), A)
+    return alpha * jnp.matmul(A, _t(A))
+
+
+@register("linalg_sumlogdiag", args=("A",))
+def _linalg_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (reference: ``linalg_sumlogdiag``)."""
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("linalg_extractdiag", args=("A",))
+def _linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", args=("A",))
+def _linalg_makediag(A, offset=0):
+    def mk(v):
+        return jnp.diag(v, k=offset)
+    for _ in range(A.ndim - 1):
+        mk = jax.vmap(mk)
+    return mk(A)
+
+
+@register("linalg_extracttrian", args=("A",))
+def _linalg_extracttrian(A, offset=0, lower=True):
+    """Flatten the triangular part (reference: ``linalg_extracttrian``)."""
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("linalg_maketrian", args=("A",))
+def _linalg_maketrian(A, offset=0, lower=True):
+    k = A.shape[-1]
+    # n(n+1)/2 = k for offset 0
+    n = int((jnp.sqrt(8 * k + 1) - 1) / 2) if offset == 0 else None
+    if n is None:
+        raise NotImplementedError("maketrian supports offset=0")
+    rows, cols = (jnp.tril_indices(n) if lower else jnp.triu_indices(n))
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_syevd", args=("A",))
+def _linalg_syevd(A):
+    """Symmetric eigendecomposition; returns (U, L) with A = U^T L U
+    rows-as-eigenvectors convention (reference: ``linalg_syevd``)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("linalg_inverse", args=("A",), aliases=("inverse",))
+def _linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", args=("A",), aliases=("det",))
+def _linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", args=("A",), aliases=("slogdet",))
+def _linalg_slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("linalg_svd", args=("A",))
+def _linalg_svd(A):
+    """Thin SVD: returns (UT, L, V) in the reference's convention
+    (A = UT^T diag(L) V)."""
+    u, s, vh = jnp.linalg.svd(A, full_matrices=False)
+    return _t(u), s, vh
+
+
+@register("moments", args=("data",))
+def _moments(data, axes=None, keepdims=False):
+    """Mean and variance over ``axes`` (reference: ``moments``)."""
+    axes = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=axes, keepdims=keepdims)
+    var = jnp.var(data, axis=axes, keepdims=keepdims)
+    return mean, var

@@ -337,6 +337,13 @@ def _probe_num_outputs(op, node):
         return 3 if node.attrs.get("mode", "lstm") == "lstm" else 2
     if op.name == "topk":
         return 2 if node.attrs.get("ret_typ") == "both" else 1
+    if op.name in ("linalg_syevd", "linalg_slogdet", "moments"):
+        return 2
+    if op.name == "linalg_svd":
+        return 3
+    if op.name in ("quantize", "quantize_v2", "requantize",
+                   "quantized_fully_connected"):
+        return 3
     return 1
 
 

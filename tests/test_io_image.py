@@ -55,7 +55,16 @@ def test_image_record_iter(tmp_path):
 
 
 def test_image_iter_sharding(tmp_path):
-    prefix = _make_rec(tmp_path)
+    # distinct labels per record so shard contents are identifiable
+    prefix = str(tmp_path / "ds")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = rng.randint(0, 255, (32, 36, 3), dtype=np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    rec.close()
     parts = []
     for pi in range(2):
         it = image.ImageIter(4, (3, 24, 24), path_imgrec=prefix + ".rec",
@@ -63,9 +72,10 @@ def test_image_iter_sharding(tmp_path):
         labels = []
         for b in it:
             labels.extend(b.label[0].asnumpy().tolist())
-        parts.append(labels)
-    # disjoint shards covering different records
-    assert len(parts[0]) + len(parts[1]) >= 8
+        parts.append(set(labels))
+    # the two shards are disjoint and together cover every record
+    assert parts[0].isdisjoint(parts[1])
+    assert parts[0] | parts[1] == set(float(i) for i in range(12))
 
 
 def test_augmenters():

@@ -1,0 +1,215 @@
+"""Linalg / control-flow / contrib op-family tests (reference:
+``test_operator.py`` linalg cases, ``test_contrib_control_flow.py``,
+``test_quantization.py``, bounding-box tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+_R = np.random.RandomState(0)
+
+
+# ----------------------------------------------------------------------
+# linalg
+# ----------------------------------------------------------------------
+
+def _spd(n=4):
+    a = _R.randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_linalg_gemm_family():
+    A = _R.randn(3, 4).astype(np.float32)
+    B = _R.randn(4, 5).astype(np.float32)
+    C = _R.randn(3, 5).astype(np.float32)
+    out = mx.nd.linalg_gemm(mx.nd.array(A), mx.nd.array(B),
+                            mx.nd.array(C), alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * A @ B + 0.5 * C,
+                               rtol=1e-5)
+    out2 = mx.nd.linalg_gemm2(mx.nd.array(A), mx.nd.array(A),
+                              transpose_b=True)
+    np.testing.assert_allclose(out2.asnumpy(), A @ A.T, rtol=1e-5)
+
+
+def test_linalg_cholesky_chain():
+    S = _spd()
+    L = mx.nd.linalg_potrf(mx.nd.array(S))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, S,
+                               rtol=1e-4, atol=1e-4)
+    inv = mx.nd.linalg_potri(L)
+    np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(S),
+                               rtol=1e-3, atol=1e-3)
+    sld = mx.nd.linalg_sumlogdiag(L)
+    assert abs(2 * float(sld.asscalar())
+               - np.linalg.slogdet(S)[1]) < 1e-3
+
+
+def test_linalg_trsm_trmm():
+    S = _spd()
+    L = np.linalg.cholesky(S).astype(np.float32)
+    B = _R.randn(4, 3).astype(np.float32)
+    X = mx.nd.linalg_trsm(mx.nd.array(L), mx.nd.array(B))
+    np.testing.assert_allclose(L @ X.asnumpy(), B, rtol=1e-4, atol=1e-4)
+    M = mx.nd.linalg_trmm(mx.nd.array(L), mx.nd.array(B))
+    np.testing.assert_allclose(M.asnumpy(), np.tril(L) @ B, rtol=1e-4)
+
+
+def test_linalg_decompositions():
+    S = _spd()
+    UT, w = mx.nd.linalg_syevd(mx.nd.array(S))
+    recon = UT.asnumpy().T @ np.diag(w.asnumpy()) @ UT.asnumpy()
+    np.testing.assert_allclose(recon, S, rtol=1e-3, atol=1e-3)
+    sign, logabs = mx.nd.linalg_slogdet(mx.nd.array(S))
+    assert sign.asscalar() == 1.0
+    d = mx.nd.linalg_det(mx.nd.array(S))
+    np.testing.assert_allclose(d.asscalar(), np.linalg.det(S), rtol=1e-3)
+    inv = mx.nd.linalg_inverse(mx.nd.array(S))
+    np.testing.assert_allclose(inv.asnumpy() @ S, np.eye(4), atol=1e-3)
+
+
+def test_linalg_grad_flows():
+    S = _spd()
+    x = mx.nd.array(S)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.linalg_sumlogdiag(mx.nd.linalg_potrf(x))
+    y.backward()
+    # d/dA 0.5*logdet(A) = 0.5*A^-1 for SPD A
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               0.5 * np.linalg.inv(S), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_moments():
+    x = _R.randn(4, 5).astype(np.float32)
+    mean, var = mx.nd.moments(mx.nd.array(x), axes=(1,))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(1), rtol=1e-4)
+
+
+# ----------------------------------------------------------------------
+# control flow
+# ----------------------------------------------------------------------
+
+def test_foreach_cumsum_and_grad():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    outs, final = mx.nd.contrib.foreach(
+        lambda x, s: (x + s, x + s), data, mx.nd.zeros((3,)))
+    expect = np.cumsum(np.arange(12).reshape(4, 3), axis=0)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final.asnumpy(), expect[-1])
+
+    x = mx.nd.ones((4, 3))
+    x.attach_grad()
+    with autograd.record():
+        o, _ = mx.nd.contrib.foreach(
+            lambda t, s: (t * 2.0 + s, s + t), x, mx.nd.zeros((3,)))
+        o.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy()[:, 0], [5, 4, 3, 2])
+
+
+def test_while_loop():
+    def cond_fn(i, s):
+        return i < 5.0
+
+    def body_fn(i, s):
+        return s, (i + 1.0, s + i)
+
+    outs, (i_f, s_f) = mx.nd.contrib.while_loop(
+        cond_fn, body_fn, (mx.nd.zeros(()), mx.nd.zeros(())),
+        max_iterations=8)
+    assert i_f.asscalar() == 5.0
+    assert s_f.asscalar() == 10.0
+    with pytest.raises(mx.MXNetError):
+        mx.nd.contrib.while_loop(cond_fn, body_fn,
+                                 (mx.nd.zeros(()), mx.nd.zeros(())))
+
+
+def test_cond():
+    five = mx.nd.array(np.array(5.0, np.float32))
+    hi = mx.nd.contrib.cond(mx.nd.array(np.array(1.0)),
+                            lambda a: a * 2, lambda a: a * 3, [five])
+    lo = mx.nd.contrib.cond(mx.nd.array(np.array(0.0)),
+                            lambda a: a * 2, lambda a: a * 3, [five])
+    assert hi.asscalar() == 10.0 and lo.asscalar() == 15.0
+
+
+# ----------------------------------------------------------------------
+# im2col / quantization / boxes / CTC
+# ----------------------------------------------------------------------
+
+def test_im2col_col2im_adjoint():
+    x = mx.nd.array(_R.randn(2, 3, 6, 6).astype(np.float32))
+    cols = mx.nd.im2col(x, kernel=(3, 3), pad=(1, 1))
+    assert cols.shape == (2, 27, 36)
+    back = mx.nd.col2im(cols, output_size=(6, 6), kernel=(3, 3),
+                        pad=(1, 1))
+    assert back.shape == x.shape
+    # center pixels participate in 9 patches
+    np.testing.assert_allclose(back.asnumpy()[:, :, 2, 2],
+                               9 * x.asnumpy()[:, :, 2, 2], rtol=1e-5)
+
+
+def test_quantize_roundtrip():
+    x = np.array([0.5, -1.0, 1.0, 0.0], np.float32)
+    q, mn, mxr = mx.nd.quantize_v2(mx.nd.array(x))
+    assert q.dtype == np.int8
+    d = mx.nd.dequantize(q, mn, mxr)
+    np.testing.assert_allclose(d.asnumpy(), x, atol=0.02)
+
+
+def test_quantized_fully_connected_close_to_fp32():
+    x = _R.randn(4, 8).astype(np.float32)
+    w = _R.randn(16, 8).astype(np.float32)
+    qx, xn, xx = mx.nd.quantize_v2(mx.nd.array(x))
+    qw, wn, wx = mx.nd.quantize_v2(mx.nd.array(w))
+    acc, on, ox = mx.nd.quantized_fully_connected(
+        qx, qw, None, xn, xx, wn, wx, None, None, num_hidden=16,
+        no_bias=True)
+    deq = mx.nd.dequantize(acc, on, ox)
+    np.testing.assert_allclose(deq.asnumpy(), x @ w.T, rtol=0.1,
+                               atol=0.15)
+
+
+def test_box_iou_nms():
+    boxes = mx.nd.array(np.array(
+        [[0, 0.9, 0, 0, 2, 2],
+         [1, 0.8, 0.1, 0.1, 2.1, 2.1],
+         [2, 0.7, 5, 5, 7, 7]], np.float32))
+    out = mx.nd.box_nms(boxes, overlap_thresh=0.5, coord_start=2,
+                        score_index=1)
+    scores = out.asnumpy()[:, 1]
+    # the overlapping second box is suppressed, the far one survives
+    assert (scores == np.array([0.9, -1.0, 0.7], np.float32)).all()
+
+    iou = mx.nd.contrib.box_iou(
+        mx.nd.array(np.array([[0, 0, 2, 2]], np.float32)),
+        mx.nd.array(np.array([[1, 1, 3, 3]], np.float32)))
+    np.testing.assert_allclose(iou.asnumpy(), [[1.0 / 7]], rtol=1e-5)
+
+
+def test_roi_pooling_shapes():
+    data = mx.nd.array(_R.randn(1, 4, 8, 8).astype(np.float32))
+    rois = mx.nd.array(np.array([[0, 0, 0, 7, 7],
+                                 [0, 2, 2, 6, 6]], np.float32))
+    out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2))
+    assert out.shape == (2, 4, 2, 2)
+    # full-image ROI max-pools the quadrants
+    top_left = data.asnumpy()[0, :, :4, :4].max(axis=(1, 2))
+    np.testing.assert_allclose(out.asnumpy()[0, :, 0, 0], top_left,
+                               rtol=1e-5)
+    out2 = mx.nd.ROIAlign(data, rois, pooled_size=(2, 2))
+    assert out2.shape == (2, 4, 2, 2)
+
+
+def test_ctc_op_matches_gluon_loss():
+    from mxnet_tpu import gluon
+    T, N, C = 8, 3, 5
+    pred = _R.randn(N, T, C).astype(np.float32)
+    label = np.stack([[1, 2], [2, 3], [1, -1]]).astype(np.float32)
+    layer = gluon.loss.CTCLoss()
+    want = layer(mx.nd.array(pred), mx.nd.array(label)).asnumpy()
+    got = mx.nd.CTCLoss(mx.nd.array(pred.transpose(1, 0, 2)),
+                        mx.nd.array(label)).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

@@ -128,13 +128,15 @@ def main(argv=None):
     if args.list:
         make_lists(args)
     else:
-        import glob
         if args.prefix.endswith(".lst"):
             lsts = [args.prefix]
         else:
             # a --test-ratio split produces prefix_train/_val.lst; pack
-            # every matching list like the reference tool
-            lsts = sorted(glob.glob(args.prefix + "*.lst"))
+            # exactly this tool's own outputs, never sibling datasets
+            lsts = [f for f in (args.prefix + ".lst",
+                                args.prefix + "_train.lst",
+                                args.prefix + "_val.lst")
+                    if os.path.exists(f)]
         if not lsts:
             p.error("no .lst file found for prefix %r" % args.prefix)
         for lst in lsts:

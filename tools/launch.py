@@ -33,9 +33,32 @@ def _free_port():
     return port
 
 
+def _kill_tree(procs):
+    """SIGTERM each worker's whole process group (workers start in
+    their own session, so wrapper scripts' grandchildren die too),
+    escalating to SIGKILL after a grace period."""
+    import signal
+    import time
+    for q in procs:
+        try:
+            os.killpg(q.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            q.terminate()
+    deadline = time.time() + 10
+    for q in procs:
+        while q.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if q.poll() is None:
+            try:
+                os.killpg(q.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                q.kill()
+            q.wait()
+
+
 def _wait_all(procs):
     """Wait for every worker, failing FAST: the first nonzero exit
-    terminates the survivors (a dead peer would otherwise wedge the
+    tears down the survivors (a dead peer would otherwise wedge the
     rest inside jax.distributed collectives); Ctrl-C tears all down."""
     import time
     try:
@@ -46,18 +69,12 @@ def _wait_all(procs):
                     continue
                 procs.remove(p)
                 if rc != 0:
-                    for q in procs:
-                        q.terminate()
-                    for q in procs:
-                        q.wait()
+                    _kill_tree(procs)
                     return rc
             time.sleep(0.1)
         return 0
     except KeyboardInterrupt:
-        for q in procs:
-            q.terminate()
-        for q in procs:
-            q.wait()
+        _kill_tree(procs)
         raise
 
 
@@ -74,7 +91,8 @@ def launch_local(args, command):
             "DMLC_ROLE": "worker",
             "DMLC_NUM_WORKER": str(args.num_workers),
         })
-        procs.append(subprocess.Popen(command, env=env))
+        procs.append(subprocess.Popen(command, env=env,
+                                      start_new_session=True))
     return _wait_all(procs)
 
 
