@@ -65,4 +65,6 @@ from . import module as mod
 from . import profiler
 from . import runtime
 from .distributed import distributed_init
+from . import numpy as np
+from . import numpy_extension as npx
 from . import test_utils

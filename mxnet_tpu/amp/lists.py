@@ -20,6 +20,9 @@ TARGET_DTYPE_OPS = [
     "Deconvolution",
     "dot",
     "batch_dot",
+    "matmul",
+    "einsum",
+    "tensordot",
     "RNN",
 ]
 
@@ -38,6 +41,8 @@ FP32_OPS = [
     "mean",
     "sum",
     "prod",
+    "_np_var",
+    "_np_std",
     "exp",
     "log",
     "log2",

@@ -256,6 +256,15 @@ class Block:
         out = self.forward(*args)
         for hook in self._forward_hooks:
             hook(self, args, out)
+        import sys
+        npx = sys.modules.get("mxnet_tpu.numpy_extension")
+        if npx is not None and npx.is_np_array():
+            # npx.set_np(): blocks speak mx.np (reference semantics)
+            from ..numpy import _view
+            if isinstance(out, (list, tuple)):
+                out = type(out)(_view(o) for o in out)
+            else:
+                out = _view(out)
         return out
 
     def forward(self, *args):

@@ -732,3 +732,69 @@ def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
         shape = (n,)
     out = start + step * jnp.arange(n, dtype=data.dtype)
     return out.reshape(shape)
+
+
+# ----------------------------------------------------------------------
+# numpy-surface ops (reference: python/mxnet/numpy -- the mx.np world).
+# Registered as ops so mx.np functions are tape-aware like everything
+# else.
+# ----------------------------------------------------------------------
+
+@register("matmul", args=("a", "b"))
+def _matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("einsum", args=("data",), variadic=True)
+def _einsum(*operands, subscripts=""):
+    return jnp.einsum(subscripts, *operands)
+
+
+@register("tensordot", args=("a", "b"))
+def _tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                     for x in axes)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register("isnan", args=("data",))
+def _isnan(data):
+    return jnp.isnan(data)
+
+
+@register("isinf", args=("data",))
+def _isinf(data):
+    return jnp.isinf(data)
+
+
+@register("isfinite", args=("data",))
+def _isfinite(data):
+    return jnp.isfinite(data)
+
+
+@register("_np_var", args=("data",))
+def _np_var(data, axis=None, ddof=0, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("_np_std", args=("data",))
+def _np_std(data, axis=None, ddof=0, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.std(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("vstack", args=("data",), variadic=True)
+def _vstack(*data):
+    return jnp.vstack(data)
+
+
+@register("hstack", args=("data",), variadic=True)
+def _hstack(*data):
+    return jnp.hstack(data)
+
+
+@register("dstack", args=("data",), variadic=True)
+def _dstack(*data):
+    return jnp.dstack(data)

@@ -1,0 +1,153 @@
+"""mx.np / mx.npx tests (reference: ``tests/python/unittest/
+test_numpy_ndarray.py`` / ``test_numpy_op.py``)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+
+
+def test_creation_and_props():
+    a = np.array([[1.0, 2], [3, 4]])
+    assert isinstance(a, np.ndarray)
+    assert a.shape == (2, 2) and a.size == 4
+    assert a.dtype == onp.float32
+    onp.testing.assert_allclose(a.T.asnumpy(), [[1, 3], [2, 4]])
+    assert np.zeros((2, 3)).asnumpy().sum() == 0
+    assert np.ones(4).asnumpy().sum() == 4
+    onp.testing.assert_allclose(np.eye(3).asnumpy(), onp.eye(3))
+    onp.testing.assert_allclose(np.arange(2, 8, 2).asnumpy(), [2, 4, 6])
+    onp.testing.assert_allclose(np.linspace(0, 1, 5).asnumpy(),
+                                onp.linspace(0, 1, 5), rtol=1e-6)
+    onp.testing.assert_allclose(np.full((2,), 7.0).asnumpy(), [7, 7])
+
+
+def test_math_matches_numpy():
+    x = onp.random.RandomState(0).rand(3, 4).astype(onp.float32) + 0.5
+    a = np.array(x)
+    onp.testing.assert_allclose(np.exp(a).asnumpy(), onp.exp(x),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.sum(a, axis=1).asnumpy(), x.sum(1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.mean(a).asnumpy(), x.mean(),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.var(a, ddof=1).asnumpy(),
+                                x.var(ddof=1), rtol=1e-4)
+    onp.testing.assert_allclose(np.std(a).asnumpy(), x.std(), rtol=1e-4)
+    onp.testing.assert_allclose((a @ a.T).asnumpy(), x @ x.T, rtol=1e-5)
+    onp.testing.assert_allclose(np.matmul(a, a.T).asnumpy(), x @ x.T,
+                                rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.tensordot(a, a, axes=([1], [1])).asnumpy(),
+        onp.tensordot(x, x, axes=([1], [1])), rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ij,kj->ik", a, a).asnumpy(),
+        onp.einsum("ij,kj->ik", x, x), rtol=1e-5)
+    onp.testing.assert_allclose(np.power(a, 2).asnumpy(), x ** 2,
+                                rtol=1e-5)
+    onp.testing.assert_allclose(np.maximum(a, 1.0).asnumpy(),
+                                onp.maximum(x, 1.0))
+
+
+def test_shaping():
+    a = np.arange(12).reshape(3, 4)
+    assert a.shape == (3, 4)
+    assert np.transpose(a).shape == (4, 3)
+    assert np.expand_dims(a, 0).shape == (1, 3, 4)
+    assert np.squeeze(np.expand_dims(a, 0)).shape == (3, 4)
+    c = np.concatenate([a, a], axis=0)
+    assert c.shape == (6, 4)
+    s = np.stack([a, a])
+    assert s.shape == (2, 3, 4)
+    parts = np.split(a, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    assert np.vstack([a, a]).shape == (6, 4)
+    assert np.hstack([a, a]).shape == (3, 8)
+
+
+def test_autograd_through_np():
+    """mx.np arrays ride the same tape as mx.nd."""
+    a = np.array([[1.0, 2], [3, 4]])
+    a.attach_grad()
+    with autograd.record():
+        loss = np.sum(np.square(a) * 3.0)
+    loss.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), 6 * a.asnumpy())
+
+
+def test_np_nd_interop():
+    a = np.ones((2, 3))
+    b = mx.nd.ones((2, 3))
+    c = a + b          # mixes freely
+    assert c.asnumpy().sum() == 12
+
+
+def test_random():
+    np.random.seed(0)
+    u = np.random.uniform(size=(100,))
+    assert 0 <= float(np.min(u).asnumpy()) and \
+        float(np.max(u).asnumpy()) <= 1
+    n = np.random.randn(50, 50)
+    assert abs(float(np.mean(n).asnumpy())) < 0.1
+    r = np.random.randint(0, 5, size=(20,))
+    assert set(onp.unique(r.asnumpy())) <= {0, 1, 2, 3, 4}
+
+
+def test_npx_ops():
+    x = np.array([[1.0, -1.0], [0.5, -0.5]])
+    onp.testing.assert_allclose(npx.relu(x).asnumpy(),
+                                [[1, 0], [0.5, 0]])
+    s = npx.softmax(x)
+    onp.testing.assert_allclose(s.asnumpy().sum(axis=1), [1, 1],
+                                rtol=1e-6)
+    w = np.ones((4, 2))
+    out = npx.fully_connected(x, w, num_hidden=4, no_bias=True)
+    assert out.shape == (2, 4)
+    oh = npx.one_hot(np.array([0.0, 1.0]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(),
+                                [[1, 0, 0], [0, 1, 0]])
+
+
+def test_npx_set_np_flag():
+    assert not npx.is_np_array()
+    try:
+        npx.set_np()
+        assert npx.is_np_array()
+        # gluon blocks now speak mx.np
+        from mxnet_tpu import gluon
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        out = net(np.ones((2, 4)))
+        assert isinstance(out, np.ndarray)
+        assert out.T.shape == (3, 2)
+    finally:
+        npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_np_semantics_numpy_edge_cases():
+    a = np.array([[1.0, 2], [3, 4]])
+    # flip with no axis flips everything
+    onp.testing.assert_allclose(np.flip(a).asnumpy(), [[4, 3], [2, 1]])
+    # take with no axis flattens
+    onp.testing.assert_allclose(
+        np.take(np.arange(6).reshape(2, 3), np.array([0.0, 4.0]))
+        .asnumpy(), [0, 4])
+    # np.array copies the buffer; asarray shares it (note: writes
+    # REBIND in this functional design, so sharing is at creation time)
+    src = mx.nd.ones((2,))
+    copied = np.array(src)
+    viewed = np.asarray(src)
+    assert viewed._data is src._data
+    assert copied._data is not src._data
+
+
+def test_npx_save_load(tmp_path):
+    f = str(tmp_path / "x.params")
+    npx.save(f, {"a": np.ones((2, 2))})
+    back = npx.load(f)
+    assert isinstance(back["a"], np.ndarray)
+    onp.testing.assert_allclose(back["a"].asnumpy(), onp.ones((2, 2)))
