@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Train a convnet on MNIST with the Gluon API (reference:
+``example/gluon/mnist/mnist.py``).
+
+Runs on the TPU when one is attached, else CPU; uses the synthetic
+MNIST fallback when the dataset cannot be downloaded (offline image).
+
+    python examples/gluon_mnist.py --epochs 2 --hybridize
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import mxnet_tpu as mx                      # noqa: E402
+from mxnet_tpu import autograd, gluon       # noqa: E402
+
+
+def build_net():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(32, kernel_size=3, activation="relu"),
+            gluon.nn.Conv2D(64, kernel_size=3, activation="relu"),
+            gluon.nn.MaxPool2D(2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dropout(0.5),
+            gluon.nn.Dense(10))
+    return net
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--hybridize", action="store_true")
+    args = p.parse_args()
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    print("training on", ctx)
+
+    train_data = gluon.data.DataLoader(
+        gluon.data.vision.MNIST(train=True).transform_first(
+            lambda d: mx.nd.array(
+                d.asnumpy().reshape(1, 28, 28) / 255.0)),
+        batch_size=args.batch_size, shuffle=True, last_batch="discard")
+
+    net = build_net()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    if args.hybridize:
+        net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for data, label in train_data:
+            data = data.as_in_context(ctx)
+            label = label.as_in_context(ctx)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([label], [out])
+            n += args.batch_size
+        name, acc = metric.get()
+        print("epoch %d: %s=%.4f (%.0f samples/s)"
+              % (epoch, name, acc, n / (time.time() - tic)))
+
+
+if __name__ == "__main__":
+    main()

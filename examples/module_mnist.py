@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Train an MLP with the legacy Module API (reference:
+``example/image-classification/train_mnist.py``): symbolic graph,
+``mod.fit`` with Speedometer and checkpointing.
+
+    python examples/module_mnist.py --epochs 2
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np                          # noqa: E402
+
+import mxnet_tpu as mx                      # noqa: E402
+from mxnet_tpu import sym                   # noqa: E402
+
+
+def mlp_symbol():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def synthetic_mnist(n=2048, seed=0):
+    # linearly separable synthetic digits: one fixed blob per class
+    centers = np.random.RandomState(42).randn(10, 784).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = centers[y] + 0.3 * rng.randn(n, 784).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--save-prefix", default="/tmp/mnist_module")
+    args = p.parse_args()
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    x, y = synthetic_mnist()
+    train = mx.io.NDArrayIter(x, y, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(*synthetic_mnist(512, seed=1),
+                            batch_size=args.batch_size)
+
+    mod = mx.mod.Module(mlp_symbol(), context=ctx)
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10),
+            epoch_end_callback=mx.callback.do_checkpoint(
+                args.save_prefix),
+            num_epoch=args.epochs)
+    score = mod.score(val, mx.metric.Accuracy())
+    print("final validation:", score)
+
+
+if __name__ == "__main__":
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    main()

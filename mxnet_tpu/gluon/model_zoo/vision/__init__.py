@@ -12,6 +12,7 @@ from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
                         get_mobilenet_v2)
 from .squeezenet import squeezenet1_0, squeezenet1_1
 from .densenet import densenet121, densenet161, densenet169, densenet201
+from .inception import inception_v3
 
 
 def get_model(name, **kwargs):
@@ -35,6 +36,7 @@ def get_model(name, **kwargs):
         "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
         "densenet121": densenet121, "densenet161": densenet161,
         "densenet169": densenet169, "densenet201": densenet201,
+        "inceptionv3": inception_v3,
     }
     name = name.lower()
     if name not in models:

@@ -69,7 +69,8 @@ def test_mixed_initializer():
 def test_get_model_registry():
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     for name in ("resnet18_v1", "resnet50_v1", "vgg11", "alexnet",
-                 "squeezenet1.0", "mobilenet1.0", "densenet121"):
+                 "squeezenet1.0", "mobilenet1.0", "densenet121",
+                 "inceptionv3"):
         net = get_model(name, classes=10)
         assert net is not None
     with pytest.raises(Exception):
