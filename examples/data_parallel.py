@@ -37,7 +37,11 @@ def main():
     devices = jax.devices()
     n = args.ndev or len(devices)
     if len(devices) < n:
-        devices = jax.devices("cpu")   # virtual CPU mesh fallback
+        devices = jax.devices("cpu")
+        if len(devices) < n:
+            sys.exit("need %d devices but only %d available; run with\n"
+                     "  XLA_FLAGS=--xla_force_host_platform_device_count"
+                     "=%d JAX_PLATFORMS=cpu" % (n, len(devices), n))
     mesh = make_mesh({"dp": n}, devices=devices[:n]) if n > 1 else None
     print("mesh:", mesh or "single device (%s)" % devices[0])
 

@@ -67,4 +67,6 @@ from . import runtime
 from .distributed import distributed_init
 from . import numpy as np
 from . import numpy_extension as npx
+from . import predictor
+from .predictor import Predictor, CompiledPredictor
 from . import test_utils

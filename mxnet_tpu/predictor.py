@@ -1,0 +1,222 @@
+"""Inference predictor + ahead-of-time compiled artifacts (reference:
+``src/c_api/c_predict_api.cc :: MXPredCreate/SetInput/Forward/GetOutput``
+and the ``amalgamation/`` edge-deploy story).
+
+Two deployment levels:
+
+- ``Predictor``: load ``-symbol.json`` + ``.params`` and serve forward
+  passes through one jitted program per input-shape class -- the
+  ``MXPredCreate`` workflow with XLA as the runtime.
+- ``export_compiled`` / ``CompiledPredictor``: the TPU-native "Edge"
+  path.  The jitted forward is AOT-lowered and serialized as portable
+  StableHLO together with the weights in one archive (``.mxa``), so the
+  serving side needs NO model definition code -- the graph, shapes, and
+  calling convention travel in the artifact, the analog of the
+  reference's amalgamated single-file deploy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zipfile
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from .ndarray import NDArray
+
+
+class Predictor:
+    """Reference: the C predict API object (``MXPredCreate``)."""
+
+    def __init__(self, symbol_file, param_file=None, ctx=None,
+                 input_shapes=None):
+        import jax
+        from . import symbol as sym_mod
+        from .symbol.symbol import _eval_symbol
+
+        self._sym = sym_mod.load(symbol_file) \
+            if isinstance(symbol_file, str) \
+            else sym_mod.load_json(symbol_file.decode()
+                                   if isinstance(symbol_file, bytes)
+                                   else symbol_file)
+        self._ctx = ctx
+        params = {}
+        if param_file:
+            for k, v in nd.load(param_file).items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                params[name] = v
+        self._params = params
+        arg_names = self._sym.list_arguments()
+        aux_names = self._sym.list_auxiliary_states()
+        self._input_names = [n for n in arg_names
+                             if n not in params and n not in aux_names]
+        if input_shapes:
+            missing = [n for n in input_shapes if n not in arg_names]
+            if missing:
+                raise MXNetError("unknown inputs %r" % missing)
+        self._input_shapes = dict(input_shapes or {})
+        self._inputs = {}
+        self._outputs = None
+
+        def pure(feed_vals):
+            class _W:
+                __slots__ = ("_data",)
+
+                def __init__(self, d):
+                    self._data = d
+            feed = {k: _W(v) for k, v in feed_vals.items()}
+            outs = _eval_symbol(self._sym, feed)
+            return tuple(o._data for o in outs)
+
+        self._pure = pure
+        self._jit = jax.jit(pure)
+
+    def set_input(self, name, arr):
+        """Reference: ``MXPredSetInput``."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r (inputs: %s)"
+                             % (name, self._input_names))
+        self._inputs[name] = arr if isinstance(arr, NDArray) \
+            else nd.array(np.asarray(arr), ctx=self._ctx)
+
+    def forward(self, **kwargs):
+        """Reference: ``MXPredForward``."""
+        for k, v in kwargs.items():
+            self.set_input(k, v)
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise MXNetError("inputs not set: %r" % missing)
+        feed = {k: v._data for k, v in self._params.items()}
+        feed.update({k: v._data for k, v in self._inputs.items()})
+        missing_aux = [n for n in self._sym.list_auxiliary_states()
+                       if n not in feed]
+        if missing_aux:
+            feed.update(self._default_aux(missing_aux))
+        self._outputs = [NDArray(o) for o in self._jit(feed)]
+        return self._outputs
+
+    def _default_aux(self, names):
+        """Default values for aux states absent from the checkpoint
+        (zeros; ones for variances).  Shapes come from ONE graph shape
+        inference, cached -- this sits on the serving hot path."""
+        cache = getattr(self, "_aux_cache", None)
+        if cache is None:
+            shapes = {n: v.shape for n, v in self._params.items()}
+            shapes.update({n: v.shape for n, v in self._inputs.items()})
+            shapes.update(self._input_shapes)
+            _, _, aux_shapes = self._sym.infer_shape(**{
+                k: shapes[k] for k in self._sym.list_arguments()
+                if k in shapes})
+            aux_names = self._sym.list_auxiliary_states()
+            cache = {
+                n: np.full(s, 1.0 if n.endswith("var") else 0.0,
+                           np.float32)
+                for n, s in zip(aux_names, aux_shapes)}
+            self._aux_cache = cache
+        return {n: cache[n] for n in names}
+
+    def get_output(self, index=0):
+        """Reference: ``MXPredGetOutput``."""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs[index]
+
+    @property
+    def output_count(self):
+        return len(self._sym._outputs)
+
+
+# ----------------------------------------------------------------------
+# AOT-compiled artifacts ("Edge" deploy)
+# ----------------------------------------------------------------------
+
+_MXA_VERSION = 1
+
+
+def export_compiled(block, path, input_shapes, dtype="float32"):
+    """AOT-compile a HybridBlock's forward and write a self-contained
+    ``.mxa`` archive: serialized StableHLO + weights + calling
+    convention.  Loading needs no model code (``CompiledPredictor``).
+    """
+    import jax
+    from jax import export as jexport
+
+    if not hasattr(block, "functionalize"):
+        raise MXNetError("export_compiled expects a HybridBlock")
+    shapes = [tuple(s) for s in input_shapes]
+    # probe on the SAME device as the parameters (they may be on TPU)
+    ctx = next((p.data().context for p in block._all_params()
+                if p._data is not None), None)
+    probe = [nd.zeros(s, ctx=ctx) for s in shapes]
+    block(*probe)  # materialize deferred params
+    pure_fn, pnames, pmap = block.functionalize(training=False)
+    pvals = {n: pmap[n]._data._data for n in pnames}
+    key = jax.random.PRNGKey(0)
+
+    def fn(pvals, *xs):
+        outs, _aux = pure_fn(pvals, list(xs), key)
+        return tuple(outs)
+
+    specs = [jax.ShapeDtypeStruct(s, np.dtype(dtype)) for s in shapes]
+    pspecs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+              for n, v in pvals.items()}
+    # multi-platform artifact: the same .mxa serves on TPU and CPU
+    # (edge deploys rarely run where they were built)
+    exported = jexport.export(jax.jit(fn),
+                              platforms=("cpu", "tpu"))(pspecs, *specs)
+    hlo = exported.serialize()
+
+    # weights in the reference .params container
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".params",
+                                     delete=False) as tf:
+        nd.save(tf.name, {n: NDArray(v) for n, v in pvals.items()})
+        with open(tf.name, "rb") as f:
+            param_bytes = f.read()
+    os.unlink(tf.name)
+
+    meta = {
+        "version": _MXA_VERSION,
+        "input_shapes": [list(s) for s in shapes],
+        "input_dtype": str(dtype),
+        "param_names": list(pvals),
+        "num_outputs": len(exported.out_avals),
+    }
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("meta.json", json.dumps(meta))
+        z.writestr("forward.stablehlo", hlo)
+        z.writestr("weights.params", param_bytes)
+    return path
+
+
+class CompiledPredictor:
+    """Serve a ``.mxa`` artifact (reference: the edge predict ABI).  The
+    StableHLO program is deserialized and executed by XLA directly; no
+    model definition or Python graph code is involved."""
+
+    def __init__(self, path):
+        import tempfile
+        from jax import export as jexport
+        with zipfile.ZipFile(path) as z:
+            self.meta = json.loads(z.read("meta.json"))
+            self._exported = jexport.deserialize(
+                z.read("forward.stablehlo"))
+            with tempfile.NamedTemporaryFile(suffix=".params",
+                                             delete=False) as tf:
+                tf.write(z.read("weights.params"))
+                pfile = tf.name
+        params = nd.load(pfile)
+        os.unlink(pfile)
+        self._pvals = {n: v._data for n, v in params.items()}
+
+    def forward(self, *inputs):
+        vals = [i._data if isinstance(i, NDArray) else np.asarray(i)
+                for i in inputs]
+        outs = self._exported.call(self._pvals, *vals)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        return [NDArray(o) for o in outs]
+
+    __call__ = forward

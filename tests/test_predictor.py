@@ -1,0 +1,55 @@
+"""Predictor / AOT artifact tests (reference: C predict API tests +
+amalgamation deploy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _trained_net(tmp_path):
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randn(2, 3, 8, 8).astype(np.float32))
+    net(x)
+    return net, x
+
+
+def test_predictor_from_export(tmp_path):
+    net, x = _trained_net(tmp_path)
+    want = net(x).asnumpy()
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+
+    pred = mx.Predictor(prefix + "-symbol.json", prefix + "-0000.params")
+    pred.forward(data=x)
+    got = pred.get_output(0).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    with pytest.raises(mx.MXNetError):
+        pred.set_input("not_an_input", x)
+
+
+def test_compiled_artifact_roundtrip(tmp_path):
+    net, x = _trained_net(tmp_path)
+    want = net(x).asnumpy()
+    path = str(tmp_path / "model.mxa")
+    mx.predictor.export_compiled(net, path, [(2, 3, 8, 8)])
+
+    served = mx.CompiledPredictor(path)
+    outs = served(x)
+    # cross-platform artifact: tolerate platform numeric differences
+    np.testing.assert_allclose(outs[0].asnumpy(), want, rtol=1e-2,
+                               atol=1e-3)
+    # the artifact is self-contained: callable with raw numpy too
+    outs2 = served(x.asnumpy())
+    np.testing.assert_allclose(outs2[0].asnumpy(), want, rtol=1e-2,
+                               atol=1e-3)
+    assert served.meta["num_outputs"] == 1
