@@ -172,10 +172,24 @@ class Block:
         self.collect_params().initialize(init, ctx, verbose, force_reinit)
 
     def cast(self, dtype):
-        for p in self._all_params():
-            p.cast(dtype)
+        """Cast parameters recursively (reference: ``Block.cast``).
+        Subclasses may override with the same signature to adjust the
+        dtype for their subtree (BatchNorm keeps statistics fp32)."""
+        self._cast_impl(dtype, set())
+
+    def _cast_impl(self, dtype, seen):
         for child in self._children.values():
-            pass  # params already covered by _all_params
+            if type(child).cast is not Block.cast:
+                # overriding subclass: honor its public hook (it
+                # recurses its own subtree via super().cast)
+                child.cast(dtype)
+            else:
+                child._cast_impl(dtype, seen)
+        for p in list(self._reg_params.values()) + \
+                list(self._scope_params.values()):
+            if id(p) not in seen:
+                seen.add(id(p))
+                p.cast(dtype)
 
     def apply(self, fn):
         for child in self._children.values():

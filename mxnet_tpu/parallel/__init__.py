@@ -9,8 +9,11 @@ from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
 from .data_parallel import (TrainStep, replicate_block, shard_batch,
                             split_and_load)
 from .sequence import ring_attention, ring_attention_sharded
+from .tensor_parallel import (ColumnParallelDense, RowParallelDense,
+                              TensorParallelMLP, shard_block_tp)
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "default_mesh",
            "local_devices", "make_mesh", "TrainStep", "replicate_block",
            "shard_batch", "split_and_load", "ring_attention",
-           "ring_attention_sharded"]
+           "ring_attention_sharded", "ColumnParallelDense",
+           "RowParallelDense", "TensorParallelMLP", "shard_block_tp"]

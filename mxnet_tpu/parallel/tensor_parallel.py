@@ -1,0 +1,132 @@
+"""Tensor (model) parallelism over mesh axes (reference: the ctx_group
+model-parallel examples, e.g. ``example/model-parallel-lstm`` -- re-done
+the SPMD way).
+
+Megatron-style sharding expressed as **sharding annotations, not
+collectives**: a column-parallel Dense splits its weight's output dim
+over the ``tp`` axis, the paired row-parallel Dense splits its input
+dim, and XLA's SPMD partitioner inserts the single all-reduce at the
+row layer's output.  No NCCL groups, no manual partial sums -- pick a
+mesh, annotate, jit (the scaling-book recipe).
+
+Use ``shard_block_tp`` to annotate an existing block's parameters by
+rule, or the ``ColumnParallelDense`` / ``RowParallelDense`` layers to
+build tp-native models; both make every param carry a NamedSharding
+that ``jit`` propagates.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+
+def _put(param, mesh, spec):
+    sh = NamedSharding(mesh, spec)
+    param._sharding = sh
+    if param._data is not None:
+        param._data._data = jax.device_put(param._data._data, sh)
+
+
+class ColumnParallelDense(nn.Dense):
+    """Dense with the weight split on the OUTPUT dim over ``tp``
+    (reference pattern: Megatron column-parallel linear).  Output stays
+    tp-sharded; follow with a RowParallelDense to come back together."""
+
+    def __init__(self, units, mesh=None, axis="tp", **kwargs):
+        super().__init__(units, **kwargs)
+        self._tp_mesh = mesh
+        self._tp_axis = axis
+
+    def shard(self, mesh=None):
+        mesh = mesh or self._tp_mesh
+        if mesh is None:
+            raise MXNetError("no mesh to shard over")
+        # weight (units, in): split rows (outputs); bias follows
+        _put(self.weight, mesh, P(self._tp_axis, None))
+        if getattr(self, "bias", None) is not None:
+            _put(self.bias, mesh, P(self._tp_axis))
+        return self
+
+
+class RowParallelDense(nn.Dense):
+    """Dense with the weight split on the INPUT dim over ``tp``: the
+    partial products all-reduce at the output (XLA inserts the psum)."""
+
+    def __init__(self, units, mesh=None, axis="tp", **kwargs):
+        super().__init__(units, **kwargs)
+        self._tp_mesh = mesh
+        self._tp_axis = axis
+
+    def shard(self, mesh=None):
+        mesh = mesh or self._tp_mesh
+        if mesh is None:
+            raise MXNetError("no mesh to shard over")
+        # weight (units, in): split columns (inputs); bias replicated
+        _put(self.weight, mesh, P(None, self._tp_axis))
+        if getattr(self, "bias", None) is not None:
+            _put(self.bias, mesh, P())
+        return self
+
+
+class TensorParallelMLP(HybridBlock):
+    """The canonical tp block: column-parallel up-projection, gelu,
+    row-parallel down-projection -- ONE all-reduce per MLP, the
+    transformer FFN recipe."""
+
+    def __init__(self, hidden, units, mesh=None, axis="tp",
+                 activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.up = ColumnParallelDense(hidden, mesh=mesh, axis=axis,
+                                          flatten=False)
+            self.act = nn.Activation(activation)
+            self.down = RowParallelDense(units, mesh=mesh, axis=axis,
+                                         flatten=False)
+
+    def shard(self, mesh=None):
+        self.up.shard(mesh)
+        self.down.shard(mesh)
+        return self
+
+    def hybrid_forward(self, F, x):
+        return self.down(self.act(self.up(x)))
+
+
+# default Megatron-ish rules for annotating an existing model:
+# (regex on param name) -> PartitionSpec builder given the tp axis name
+_DEFAULT_RULES = [
+    (r".*(qkv|query|key|value|up|fc1|ffn_1|intermediate).*weight",
+     lambda ax: P(ax, None)),
+    (r".*(qkv|query|key|value|up|fc1|ffn_1|intermediate).*bias",
+     lambda ax: P(ax)),
+    (r".*(proj|out|down|fc2|ffn_2|output).*weight",
+     lambda ax: P(None, ax)),
+    (r".*embed.*weight", lambda ax: P(None, ax)),
+]
+
+
+def shard_block_tp(block, mesh, axis="tp", rules=None):
+    """Annotate an existing block's parameters with tp shardings by
+    name rule; unmatched params are replicated.  Returns the names that
+    were tp-sharded (for asserting coverage in tests)."""
+    rules = [(re.compile(pat), fn) for pat, fn in
+             (rules or _DEFAULT_RULES)]
+    sharded = []
+    for p in block.collect_params().values():
+        spec = None
+        for pat, fn in rules:
+            if pat.match(p.name):
+                spec = fn(axis)
+                break
+        if spec is None:
+            spec = P()
+        else:
+            sharded.append(p.name)
+        _put(p, mesh, spec)
+    return sharded

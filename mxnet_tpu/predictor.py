@@ -70,7 +70,6 @@ class Predictor:
             outs = _eval_symbol(self._sym, feed)
             return tuple(o._data for o in outs)
 
-        self._pure = pure
         self._jit = jax.jit(pure)
 
     def set_input(self, name, arr):
@@ -146,11 +145,13 @@ def export_compiled(block, path, input_shapes, dtype="float32"):
     if not hasattr(block, "functionalize"):
         raise MXNetError("export_compiled expects a HybridBlock")
     shapes = [tuple(s) for s in input_shapes]
-    # probe on the SAME device as the parameters (they may be on TPU)
-    ctx = next((p.data().context for p in block._all_params()
-                if p._data is not None), None)
-    probe = [nd.zeros(s, ctx=ctx) for s in shapes]
-    block(*probe)  # materialize deferred params
+    if any(p._data is None for p in block._all_params()):
+        # materialize deferred params with one probe forward, on the
+        # SAME device (and dtype) the materialized params use
+        ctx = next((p.data().context for p in block._all_params()
+                    if p._data is not None), None)
+        probe = [nd.zeros(s, ctx=ctx).astype(dtype) for s in shapes]
+        block(*probe)
     pure_fn, pnames, pmap = block.functionalize(training=False)
     pvals = {n: pmap[n]._data._data for n in pnames}
     key = jax.random.PRNGKey(0)
