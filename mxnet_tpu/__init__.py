@@ -69,4 +69,7 @@ from . import numpy as np
 from . import numpy_extension as npx
 from . import predictor
 from .predictor import Predictor, CompiledPredictor
+from . import visualization as viz
+visualization = viz
+from . import onnx
 from . import test_utils

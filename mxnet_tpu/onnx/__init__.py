@@ -1,0 +1,66 @@
+"""ONNX interchange (reference: ``python/mxnet/onnx`` /
+``mx.contrib.onnx``).
+
+The environment this framework is developed in has no ``onnx`` package
+(zero egress), so the converter is **API-gated**: the public surface and
+the op mapping table exist, and `export_model`/`import_model` raise a
+clear error until `onnx` is importable.  The graph side is ready -- our
+``-symbol.json`` DAG maps 1:1 onto an ONNX GraphProto (op nodes +
+initializers from the ``.params`` file).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+# op-name mapping our graphs would emit (subset; extended on demand)
+MX2ONNX_OP = {
+    "FullyConnected": "Gemm",
+    "Convolution": "Conv",
+    "Activation": None,           # dispatched on act_type
+    "relu": "Relu",
+    "sigmoid": "Sigmoid",
+    "tanh": "Tanh",
+    "softmax": "Softmax",
+    "Pooling": None,              # MaxPool/AveragePool on pool_type
+    "BatchNorm": "BatchNormalization",
+    "Flatten": "Flatten",
+    "Concat": "Concat",
+    "elemwise_add": "Add",
+    "elemwise_mul": "Mul",
+    "Dropout": "Dropout",
+    "Reshape": "Reshape",
+    "transpose": "Transpose",
+    "dot": "MatMul",
+}
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise MXNetError(
+            "the `onnx` package is not available in this environment; "
+            "mx.onnx export/import is gated until it is installed") from e
+
+
+def export_model(sym, params, in_shapes=None, in_types=None,
+                 onnx_file_path="model.onnx", **kwargs):
+    """Reference: ``mx.onnx.export_model``.
+
+    NOT IMPLEMENTED: conversion needs the onnx package to build and
+    validate GraphProtos, which this environment cannot install; the
+    call raises either way (with the missing-package cause chained when
+    that is the blocker)."""
+    _require_onnx()
+    raise MXNetError("mx.onnx.export_model conversion is not implemented "
+                     "yet (the graph mapping table MX2ONNX_OP is the "
+                     "starting point)")
+
+
+def import_model(model_file):
+    """Reference: ``mx.contrib.onnx.import_model``.  NOT IMPLEMENTED --
+    see export_model."""
+    _require_onnx()
+    raise MXNetError("mx.onnx.import_model conversion is not implemented "
+                     "yet")

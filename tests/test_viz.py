@@ -1,0 +1,37 @@
+"""Visualization + onnx-gate tests (reference: ``test_viz.py``)."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _net():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary(capsys):
+    total = mx.viz.print_summary(_net(), shape={"data": (2, 8)})
+    out = capsys.readouterr().out
+    assert "FullyConnected" in out and "fc1" in out
+    assert "(2, 4)" in out            # output shape of fc2
+    # learnable params only: fc1 16*8+16, fc2 4*16+4 (label excluded)
+    assert total == 16 * 8 + 16 + 4 * 16 + 4
+
+
+def test_plot_network_gated_or_works():
+    try:
+        dot = mx.viz.plot_network(_net())
+        assert "fc1" in dot.source
+    except mx.MXNetError as e:
+        assert "graphviz" in str(e)
+
+
+def test_onnx_gated():
+    with pytest.raises(mx.MXNetError, match="onnx"):
+        mx.onnx.export_model(_net(), {})
+    with pytest.raises(mx.MXNetError, match="onnx"):
+        mx.onnx.import_model("x.onnx")
