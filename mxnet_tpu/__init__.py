@@ -72,4 +72,5 @@ from .predictor import Predictor, CompiledPredictor
 from . import visualization as viz
 visualization = viz
 from . import onnx
+from . import horovod
 from . import test_utils

@@ -36,17 +36,25 @@ def _accelerator_platforms():
 
 
 def _jax_devices_for(dev_type_name):
-    try:
-        if dev_type_name == "cpu":
-            return [d for d in jax.devices() if d.platform == "cpu"] or \
-                jax.devices("cpu")
-        for plat in _accelerator_platforms():
-            devs = [d for d in jax.devices() if d.platform == plat]
-            if devs:
-                return devs
-        return []
-    except RuntimeError:
-        return []
+    # Addressable devices only: in a multi-process world jax.devices()
+    # spans every host, and placing data on another process's device is
+    # an error (contexts are per-worker, like the reference).  Real
+    # backend-initialization failures propagate with their root cause;
+    # only "this platform is absent" is treated as empty.
+    local = jax.local_devices()
+    if dev_type_name == "cpu":
+        cpus = [d for d in local if d.platform == "cpu"]
+        if not cpus:
+            try:
+                cpus = jax.local_devices(backend="cpu")
+            except RuntimeError:
+                cpus = []
+        return cpus
+    for plat in _accelerator_platforms():
+        devs = [d for d in local if d.platform == plat]
+        if devs:
+            return devs
+    return []
 
 
 class Context:

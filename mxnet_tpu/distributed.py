@@ -30,9 +30,143 @@ def distributed_init(coordinator_address=None, num_processes=None,
     if coordinator_address is None or num_processes <= 1:
         return False
     import jax
+    # CPU backends need a cross-process collectives implementation to
+    # join a multi-process world (TPU uses ICI natively)
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
     _initialized = True
     return True
+
+
+# ----------------------------------------------------------------------
+# Host-side collectives over the coordination service.
+#
+# On TPU pods the backend is multi-process and XLA collectives ride
+# ICI/DCN (use those inside jit).  On backends without cross-process
+# support (CPU jaxlib without gloo -- this image), the coordination
+# service's key-value store still spans all processes, so host-side
+# reduction goes through it -- structurally the reference's ps-lite
+# server path: workers push values, every worker pulls and reduces.
+# ----------------------------------------------------------------------
+
+_seq = [0]
+_my_old_keys = []   # this rank's keys from past rounds, deleted lazily
+
+
+def _kv_set(client, key, data):
+    if hasattr(client, "key_value_set_bytes"):
+        client.key_value_set_bytes(key, data)
+    else:
+        import base64
+        client.key_value_set(key, base64.b64encode(data).decode())
+
+
+def _kv_get(client, key, timeout_ms):
+    if hasattr(client, "blocking_key_value_get_bytes"):
+        return client.blocking_key_value_get_bytes(key, timeout_ms)
+    import base64
+    return base64.b64decode(client.blocking_key_value_get(key,
+                                                          timeout_ms))
+
+
+def _gc_old_keys(client):
+    """Delete this rank's keys from two rounds back.  Collectives are
+    lockstep on _seq: entering round N+1 implies every rank has POSTED
+    round N, hence fully consumed round N-1 -- deleting N-1 entries is
+    race-free, and the coordinator store stays bounded."""
+    while len(_my_old_keys) > 1:
+        key = _my_old_keys.pop(0)
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+
+
+def world():
+    """(num_processes, process_id) of the connected world (1, 0 when
+    single-process)."""
+    from jax._src import distributed
+    gs = distributed.global_state
+    if gs.client is None:
+        return 1, 0
+    return gs.num_processes, gs.process_id
+
+
+def _client():
+    from jax._src import distributed
+    return distributed.global_state.client
+
+
+def host_allreduce(arr, average=False, timeout_ms=60000):
+    """Sum (or mean) a host array across every process.  Uses backend
+    collectives when the backend is multi-process; otherwise the
+    coordination-service KV store."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    nproc, rank = world()
+    if nproc == 1:
+        return jnp.asarray(arr)
+    if jax.process_count() == nproc:
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(jnp.asarray(arr))
+        return jnp.mean(g, axis=0) if average else jnp.sum(g, axis=0)
+    client = _client()
+    x = np.asarray(arr)
+    _seq[0] += 1
+    tag = "mxkv_ar/%d" % _seq[0]
+    my_key = "%s/%d" % (tag, rank)
+    _kv_set(client, my_key, x.tobytes())
+    total = np.zeros_like(x)
+    for r in range(nproc):
+        raw = _kv_get(client, "%s/%d" % (tag, r), timeout_ms)
+        total += np.frombuffer(raw, dtype=x.dtype).reshape(x.shape)
+    _my_old_keys.append(my_key)
+    _gc_old_keys(client)
+    if average:
+        total = total / nproc
+    return jnp.asarray(total)
+
+
+def host_broadcast(arr, root=0, timeout_ms=60000):
+    """Every process receives root's value."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    nproc, rank = world()
+    if nproc == 1:
+        return jnp.asarray(arr)
+    client = _client()
+    x = np.asarray(arr)
+    _seq[0] += 1
+    tag = "mxkv_bc/%d" % _seq[0]
+    if rank == root:
+        _kv_set(client, tag, x.tobytes())
+        out = x
+    else:
+        raw = _kv_get(client, tag, timeout_ms)
+        out = np.frombuffer(raw, dtype=x.dtype).reshape(x.shape)
+    # broadcast has no natural lockstep (root does not read), so a
+    # barrier gates the delete: after it, every rank has consumed the key
+    client.wait_at_barrier(tag + "/done", timeout_ms)
+    if rank == root:
+        try:
+            client.key_value_delete(tag)
+        except Exception:
+            pass
+    return jnp.asarray(out)
+
+
+def barrier(name="mxnet_tpu_barrier", timeout_ms=60000):
+    nproc, _ = world()
+    if nproc == 1:
+        return
+    _seq[0] += 1
+    _client().wait_at_barrier("%s/%d" % (name, _seq[0]), timeout_ms)

@@ -1,0 +1,89 @@
+"""Horovod-style data-parallel API (reference: the ``mxnet+horovod``
+integration -- ``hvd.init/rank/size/DistributedTrainer/broadcast_parameters``
+pattern from the reference's large-batch examples).
+
+TPU-native mapping: there is no MPI ring to manage -- processes join the
+``jax.distributed`` world (one call), and the reduction primitives are
+XLA collectives.  The API shape is kept so reference training scripts
+port by changing the import.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .distributed import distributed_init
+from .gluon.trainer import Trainer
+from .ndarray import NDArray
+
+_initialized = False
+
+
+def init():
+    """``hvd.init()``: join the multi-process world (env-driven; no-op
+    when single-process)."""
+    global _initialized
+    distributed_init()
+    _initialized = True
+
+
+def rank():
+    from .distributed import world
+    return world()[1]
+
+
+def size():
+    from .distributed import world
+    return world()[0]
+
+
+def local_rank():
+    return 0  # one process per host-slice in the jax runtime model
+
+
+def allreduce(tensor, average=True, name=None):
+    """Sum (or mean) a host-local array across workers."""
+    from .distributed import host_allreduce, world
+    x = tensor._data if isinstance(tensor, NDArray) else jnp.asarray(tensor)
+    if world()[0] > 1:
+        x = host_allreduce(x, average=average)
+    return NDArray(x)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Make every worker start from root's weights (reference:
+    ``hvd.broadcast_parameters``)."""
+    from .distributed import host_broadcast, world
+    if world()[0] == 1:
+        return
+    items = params.items() if hasattr(params, "items") else params
+    for _name, p in items:
+        arr = p.data() if hasattr(p, "data") else p
+        arr._data = host_broadcast(np.asarray(arr._data), root_rank)
+
+
+class DistributedTrainer(Trainer):
+    """``hvd.DistributedTrainer``: a Gluon Trainer whose gradients
+    average across the process world before each update."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, **kwargs):
+        super().__init__(params, optimizer, optimizer_params,
+                         kvstore=None, **kwargs)
+        from .distributed import world
+        if not _initialized and world()[0] > 1:
+            raise MXNetError("call horovod.init() first")
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        from .distributed import world
+        if world()[0] > 1:
+            for p in self._params:
+                if p.grad_req == "null" or p._data is None \
+                        or p._data._grad is None:
+                    # mirror the base Trainer's stale-grad guard
+                    continue
+                g = p.grad()
+                g._data = allreduce(g, average=True)._data
+        super().step(batch_size, ignore_stale_grad)

@@ -39,12 +39,13 @@ __all__ = ["KVStore", "create"]
 
 
 def _allreduce_across_processes(x):
-    """Sum a host-local array across all jax processes (DCN path)."""
-    if jax.process_count() == 1:
+    """Sum a host-local array across all processes (DCN path): backend
+    collectives on multi-process backends (TPU pods), the coordination
+    service otherwise (``distributed.host_allreduce``)."""
+    from .distributed import host_allreduce, world
+    if world()[0] == 1:
         return x
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(x)
-    return jnp.sum(gathered, axis=0)
+    return host_allreduce(x, average=False)
 
 
 class _TwoBitCompression:
@@ -80,11 +81,13 @@ class KVStore:
     # -- topology ------------------------------------------------------
     @property
     def rank(self):
-        return jax.process_index() if self._is_dist else 0
+        from .distributed import world
+        return world()[1] if self._is_dist else 0
 
     @property
     def num_workers(self):
-        return jax.process_count() if self._is_dist else 1
+        from .distributed import world
+        return world()[0] if self._is_dist else 1
 
     # -- core API ------------------------------------------------------
     def _keyify(self, key):
@@ -272,9 +275,9 @@ class KVStore:
             self._updater.set_states(f.read())
 
     def barrier(self):
-        if self._is_dist and jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("kvstore_barrier")
+        if self._is_dist:
+            from .distributed import barrier
+            barrier("kvstore_barrier")
 
 
 def create(name="local"):
