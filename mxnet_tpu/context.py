@@ -15,7 +15,7 @@ import jax
 from .base import MXNetError
 
 __all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
-           "num_gpus", "num_tpus", "DeviceType"]
+           "num_gpus", "num_tpus", "gpu_memory_info", "DeviceType"]
 
 
 class DeviceType:
@@ -118,6 +118,21 @@ class Context:
     def empty_cache(self):
         """Reference: ``Context.empty_cache`` -- XLA manages HBM; no-op."""
 
+    def memory_info(self):
+        """(bytes_in_use, bytes_limit) for this device (reference:
+        ``mx.context.gpu_memory_info``).  PJRT owns the allocator; this
+        is its accounting surface.  Returns (0, 0) when the backend does
+        not expose stats (e.g. a tunneled device)."""
+        try:
+            stats = self.jax_device().memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return (0, 0)
+        return (int(stats.get("bytes_in_use", 0)),
+                int(stats.get("bytes_limit",
+                              stats.get("bytes_reservable_limit", 0))))
+
 
 def cpu(device_id=0):
     return Context("cpu", device_id)
@@ -151,3 +166,13 @@ def current_context():
     if stack:
         return stack[-1]
     return Context("cpu", 0)
+
+
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes on the accelerator (reference:
+    ``mx.context.gpu_memory_info``; here the TPU's HBM accounting).
+    (0, 0) when the backend reports no usable limit."""
+    used, limit = tpu(device_id).memory_info()
+    if limit <= 0:
+        return (0, 0)
+    return (max(limit - used, 0), limit)

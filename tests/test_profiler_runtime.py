@@ -103,3 +103,11 @@ def test_scalar_binops_use_scalar_ops():
     np.testing.assert_allclose((x == 2.0).asnumpy(),
                                (np.arange(6).reshape(2, 3) == 2)
                                .astype(np.float32))
+
+
+def test_memory_info_surface():
+    used, limit = mx.cpu().memory_info()
+    assert used >= 0 and limit >= 0
+    free, total = mx.context.gpu_memory_info() if mx.num_tpus() \
+        else (0, 0)
+    assert free >= 0 and total >= 0
