@@ -275,8 +275,12 @@ def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, use_pallas=False):
     if use_pallas and axis in (-1, data.ndim - 1):
         from .pallas import layernorm as _pln
         if _pln._HAS_PALLAS:
-            return _ln_pallas(data, gamma, beta, float(eps))
-        # no pallas in this build: fall through to the XLA path
+            try:
+                return _ln_pallas(data, gamma, beta, float(eps))
+            except Exception:
+                # backend without compiled-pallas support (e.g. CPU):
+                # fall through to the XLA path
+                pass
     xf = data.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axis, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=axis, keepdims=True)

@@ -39,6 +39,8 @@ def layernorm_fwd_pallas(x, gamma, beta, eps=1e-5, block_rows=128,
                          interpret=False):
     """LayerNorm over the last dim of a 2-D (rows, dim) input."""
     rows, dim = x.shape
+    if rows == 0:
+        return x
     block_rows = min(block_rows, rows)
     while rows % block_rows != 0:
         block_rows -= 1          # largest divisor <= requested block

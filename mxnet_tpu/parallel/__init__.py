@@ -11,9 +11,15 @@ from .data_parallel import (TrainStep, replicate_block, shard_batch,
 from .sequence import ring_attention, ring_attention_sharded
 from .tensor_parallel import (ColumnParallelDense, RowParallelDense,
                               TensorParallelMLP, shard_block_tp)
+from .pipeline import (pipeline_apply, shard_stacked_params,
+                       stack_stage_params)
+from .moe import MixtureOfExperts, moe_load_balancing_loss
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "default_mesh",
            "local_devices", "make_mesh", "TrainStep", "replicate_block",
            "shard_batch", "split_and_load", "ring_attention",
            "ring_attention_sharded", "ColumnParallelDense",
-           "RowParallelDense", "TensorParallelMLP", "shard_block_tp"]
+           "RowParallelDense", "TensorParallelMLP", "shard_block_tp",
+           "pipeline_apply", "shard_stacked_params",
+           "stack_stage_params", "MixtureOfExperts",
+           "moe_load_balancing_loss"]

@@ -26,11 +26,16 @@ from ..gluon import nn
 from ..gluon.block import HybridBlock
 
 
-def _put(param, mesh, spec):
+def place_param(param, mesh, spec):
+    """Record + apply a NamedSharding on a Parameter (deferred params
+    get it at materialization via Parameter._sharding)."""
     sh = NamedSharding(mesh, spec)
     param._sharding = sh
     if param._data is not None:
         param._data._data = jax.device_put(param._data._data, sh)
+
+
+_put = place_param  # internal alias used by the layer classes below
 
 
 class ColumnParallelDense(nn.Dense):
