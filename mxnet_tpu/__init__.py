@@ -73,4 +73,8 @@ from . import visualization as viz
 visualization = viz
 from . import onnx
 from . import horovod
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from .optimizer import lr_scheduler as lr_scheduler
 from . import test_utils

@@ -56,6 +56,14 @@ class _NameManager:
             cls._current = _NameManager()
         return cls._current
 
+    def __enter__(self):
+        self._old = _NameManager._current
+        _NameManager._current = self
+        return self
+
+    def __exit__(self, *args):
+        _NameManager._current = self._old
+
 
 def build_param_doc(params) -> str:
     """Render an op's typed parameter list as a numpydoc section.

@@ -6,3 +6,4 @@ from .trainer import Trainer
 from . import data  # noqa: F401
 from . import rnn  # noqa: F401
 from . import model_zoo  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,59 @@
+"""Contrib layers (reference: ``gluon/contrib/nn/basic_layers.py``)."""
+from __future__ import annotations
+
+from ..block import Block, HybridBlock
+from .. import nn as _nn
+
+
+class Concurrent(Block):
+    """Parallel branches concatenated on ``axis`` (reference:
+    ``Concurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._children[str(len(self._children))] = b
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        outs = [b(x) for b in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    """Compilable Concurrent (reference: ``HybridConcurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._children[str(len(self._children))] = b
+
+    def hybrid_forward(self, F, x):
+        outs = [b(x) for b in self._children.values()]
+        return F.Concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (reference: ``Identity``) -- the residual-branch
+    placeholder."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(_nn.Embedding):
+    """Embedding with the row-sparse gradient INTENT (reference:
+    ``SparseEmbedding``).  TPU-first note: gradients stay dense-tiled in
+    the compiled step (see ``ndarray/sparse.py`` design note); the
+    row-sparse win is realized on the kvstore/optimizer side via
+    ``row_sparse_pull`` + row-sparse updates."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         sparse_grad=True, **kwargs)

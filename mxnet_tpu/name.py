@@ -1,0 +1,14 @@
+"""Name manager (reference: ``python/mxnet/name.py``): exposes the
+shared auto-naming scope as the public ``mx.name`` surface."""
+from .base import _NameManager as NameManager
+
+
+class Prefix(NameManager):
+    """Prepend a prefix to every auto name (reference: ``Prefix``)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

@@ -12,11 +12,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
-from ..gluon.parameter import Parameter
 
 
 class MixtureOfExperts(HybridBlock):

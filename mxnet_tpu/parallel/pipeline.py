@@ -49,15 +49,18 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
     (M, mb, ...) outputs.  Differentiable end-to-end (ppermute
     transposes to the reverse rotation).
     """
-    try:
-        from jax import shard_map as _sm
-        shard_map = functools.partial(_sm, check_vma=False)
-    except ImportError:  # older jax: experimental API, check_rep kwarg
-        from jax.experimental.shard_map import shard_map as _sm
-        shard_map = functools.partial(_sm, check_rep=False)
+    from jax import shard_map as _sm
+    shard_map = functools.partial(_sm, check_vma=False)
 
     S = mesh.shape[axis]
     M = microbatches.shape[0]
+    lead = {leaf.shape[0]
+            for leaf in jax.tree_util.tree_leaves(stacked_params)}
+    if lead != {S}:
+        raise MXNetError(
+            "stacked params have leading stage dim(s) %s but the %r mesh "
+            "axis has %d devices; stack exactly one stage per device"
+            % (sorted(lead), axis, S))
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def run(params, xs):

@@ -238,8 +238,10 @@ class Symbol:
 
 
 def var(name, shape=None, dtype=None, **kwargs):
-    """Create a variable symbol (reference: ``symbol.var``)."""
-    attrs = {}
+    """Create a variable symbol (reference: ``symbol.var``).  Picks up
+    any enclosing AttrScope attributes, as op nodes do."""
+    from ..attribute import AttrScope
+    attrs = AttrScope.current_attrs()
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
@@ -312,16 +314,23 @@ def _make_node(opname, input_syms, params, name=None):
     # fc_weight/fc_bias arguments implicitly.
     if not op.variadic and len(inputs) < len(op.arg_names):
         aux_map = _AUX_ARGS.get(opname, {})
+        from ..attribute import AttrScope
+        scope_attrs = AttrScope.current_attrs()
         for arg_name in op.arg_names[len(inputs):]:
             if _skip_auto_var(opname, params, arg_name):
                 continue
-            attrs = {}
+            attrs = dict(scope_attrs)
             if arg_name in aux_map:
                 attrs["__aux__"] = "1"
             vnode = _Node(None, "%s_%s" % (name, arg_name), attrs, [])
             inputs.append((vnode, 0))
-    # count outputs via an abstract probe later; store param attrs now
-    node = _Node(opname, name, dict(params), inputs)
+    # count outputs via an abstract probe later; store param attrs now,
+    # under any enclosing AttrScope attributes (reference: AttrScope
+    # attaches e.g. ctx_group to every symbol made in the scope)
+    from ..attribute import AttrScope
+    attrs = AttrScope.current_attrs()
+    attrs.update(params)
+    node = _Node(opname, name, attrs, inputs)
     node.num_outputs = _probe_num_outputs(op, node)
     return Symbol([(node, i) for i in range(node.num_outputs)]) \
         if node.num_outputs > 1 else Symbol([(node, 0)])

@@ -1,0 +1,87 @@
+"""Small API-parity surfaces: gluon.contrib, mx.name, mx.AttrScope,
+mx.lr_scheduler alias (reference: the corresponding python/mxnet
+modules)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_hybrid_concurrent_and_identity():
+    net = gluon.contrib.nn.HybridConcurrent(axis=1)
+    net.add(gluon.nn.Dense(3, flatten=False),
+            gluon.contrib.nn.Identity(),
+            gluon.nn.Dense(2, flatten=False))
+    net.initialize()
+    x = mx.nd.ones((4, 5))
+    out = net(x)
+    assert out.shape == (4, 3 + 5 + 2)
+    net.hybridize()
+    out2 = net(x)
+    np.testing.assert_allclose(out2.asnumpy(), out.asnumpy(), rtol=1e-6)
+
+
+def test_concurrent_imperative():
+    net = gluon.contrib.nn.Concurrent(axis=-1)
+    net.add(gluon.contrib.nn.Identity(), gluon.contrib.nn.Identity())
+    out = net(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 6)
+
+
+def test_sparse_embedding_forward():
+    emb = gluon.contrib.nn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array(np.array([1.0, 3.0])))
+    assert out.shape == (2, 4)
+
+
+def test_name_prefix_scope():
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2)
+    assert s.name.startswith("stage1_")
+
+
+def test_attr_scope_on_symbols():
+    with mx.AttrScope(ctx_group="dev1", __custom__="yes"):
+        s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2,
+                                  name="fc")
+    assert s.attr("ctx_group") == "dev1"
+    assert s.attr("__custom__") == "yes"
+    # attrs survive the json round trip
+    s2 = mx.sym.load_json(s.tojson())
+    assert s2.attr("ctx_group") == "dev1"
+
+
+def test_lr_scheduler_alias():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5,
+                                            base_lr=1.0)
+    assert sched(0) == 1.0
+    assert sched(11) == 0.5   # reference decays once num_update > step
+
+
+def test_attr_scope_covers_variables_and_auto_vars():
+    with mx.AttrScope(ctx_group="dev2"):
+        v = mx.sym.var("data")
+        s = mx.sym.FullyConnected(v, num_hidden=2, name="fc")
+    assert v.attr("ctx_group") == "dev2"
+    weight_nodes = [n for n in s._topo()
+                    if n.op is None and n.name == "fc_weight"]
+    assert weight_nodes and weight_nodes[0].attrs.get(
+        "ctx_group") == "dev2"
+
+
+def test_pipeline_stage_count_mismatch_raises():
+    import jax
+    import jax.numpy as jnp
+    import pytest
+    from mxnet_tpu.parallel import (make_mesh, pipeline_apply,
+                                    stack_stage_params)
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("need 4 cpu devices")
+    mesh = make_mesh({"pp": 4}, devices=devs[:4])
+    trees = [{"w": jnp.ones((2, 2))} for _ in range(8)]   # 8 != 4
+    stacked = stack_stage_params(trees)
+    xs = jnp.ones((2, 2, 2))
+    with pytest.raises(mx.MXNetError, match="stage"):
+        pipeline_apply(lambda p, x: x @ p["w"], stacked, xs, mesh)
