@@ -54,13 +54,16 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
 
     S = mesh.shape[axis]
     M = microbatches.shape[0]
-    lead = {leaf.shape[0]
-            for leaf in jax.tree_util.tree_leaves(stacked_params)}
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    if not leaves:
+        raise MXNetError("stacked_params has no array leaves")
+    lead = {leaf.shape[0] if leaf.ndim else None for leaf in leaves}
     if lead != {S}:
         raise MXNetError(
             "stacked params have leading stage dim(s) %s but the %r mesh "
-            "axis has %d devices; stack exactly one stage per device"
-            % (sorted(lead), axis, S))
+            "axis has %d devices; stack exactly one stage per device "
+            "(scalar leaves cannot be staged)"
+            % (sorted(map(str, lead)), axis, S))
     perm = [(i, (i + 1) % S) for i in range(S)]
 
     def run(params, xs):

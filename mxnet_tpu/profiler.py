@@ -129,3 +129,109 @@ class Profiler:
 
     def __exit__(self, *exc):
         stop()
+
+
+class Domain:
+    """Reference: ``profiler.Domain`` -- a named grouping for custom
+    objects."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+
+def _region_name(a, b):
+    """Reference calling conventions: ``Task(domain, name)`` /
+    ``Frame(domain, name)`` take the Domain first; ``Event(name)`` takes
+    just a name.  Accept both orders."""
+    if b is None:
+        return str(a)
+    return "%s::%s" % (a, b) if isinstance(a, Domain) else str(b)
+
+
+class _NamedRegion:
+    """Base for the reference's custom profiler objects (``Task``,
+    ``Frame``, ``Event``): start/stop (or ``with``) brackets a named
+    region in the device trace."""
+
+    def __init__(self, domain_or_name, name=None):
+        self.name = _region_name(domain_or_name, name)
+        self._cm = None
+
+    def start(self):
+        if _scopes_enabled:
+            import jax
+            self._cm = jax.profiler.TraceAnnotation(self.name)
+            self._cm.__enter__()
+
+    def stop(self):
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_NamedRegion):
+    """Reference: ``profiler.Task``."""
+
+
+class Frame(_NamedRegion):
+    """Reference: ``profiler.Frame``."""
+
+
+class Event(_NamedRegion):
+    """Reference: ``profiler.Event``."""
+
+
+class Counter:
+    """Named counter (reference: ``profiler.Counter(domain, name,
+    value)``).  Values are kept host-side; re-constructing an existing
+    name attaches to it without resetting."""
+
+    _counters = {}
+
+    def __init__(self, domain_or_name, name=None, value=None):
+        self.name = _region_name(domain_or_name, name)
+        if value is not None:
+            Counter._counters[self.name] = value
+        else:
+            Counter._counters.setdefault(self.name, 0)
+
+    def set_value(self, value):
+        Counter._counters[self.name] = value
+
+    def increment(self, delta=1):
+        Counter._counters[self.name] = \
+            Counter._counters.get(self.name, 0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    @property
+    def value(self):
+        return Counter._counters.get(self.name, 0)
+
+
+def marker(name, scope="process"):
+    """Instant event (reference: ``profiler.Marker``/``set_marker``):
+    recorded as a zero-length annotation."""
+    if _scopes_enabled:
+        import jax
+        with jax.profiler.TraceAnnotation("marker:" + name):
+            pass
+
+
+# reference env: start profiling at import when requested; the trace
+# only hits disk at stop, so flush at interpreter exit
+if os.environ.get("MXNET_PROFILER_AUTOSTART") == "1":
+    import atexit
+    set_state("run")
+    atexit.register(stop)

@@ -308,13 +308,13 @@ def _make_node(opname, input_syms, params, name=None):
                 continue
             raise MXNetError("op %s: cannot take group symbol" % opname)
         inputs.append(s._outputs[0])
+    from ..attribute import AttrScope
     # Auto-create variables for omitted tensor args (reference: nnvm
     # composition creates "{name}_{arg}" vars for missing inputs) so
     # Module users write `sym.FullyConnected(data, num_hidden=k)` and get
     # fc_weight/fc_bias arguments implicitly.
     if not op.variadic and len(inputs) < len(op.arg_names):
         aux_map = _AUX_ARGS.get(opname, {})
-        from ..attribute import AttrScope
         scope_attrs = AttrScope.current_attrs()
         for arg_name in op.arg_names[len(inputs):]:
             if _skip_auto_var(opname, params, arg_name):
@@ -327,7 +327,6 @@ def _make_node(opname, input_syms, params, name=None):
     # count outputs via an abstract probe later; store param attrs now,
     # under any enclosing AttrScope attributes (reference: AttrScope
     # attaches e.g. ctx_group to every symbol made in the scope)
-    from ..attribute import AttrScope
     attrs = AttrScope.current_attrs()
     attrs.update(params)
     node = _Node(opname, name, attrs, inputs)

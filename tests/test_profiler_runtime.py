@@ -111,3 +111,19 @@ def test_memory_info_surface():
     free, total = mx.context.gpu_memory_info() if mx.num_tpus() \
         else (0, 0)
     assert free >= 0 and total >= 0
+
+
+def test_profiler_custom_objects(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "p.json"))
+    mx.profiler.start()
+    with mx.profiler.Task("io_phase"):
+        mx.nd.ones((4,)).asnumpy()
+    ev = mx.profiler.Event("step")
+    ev.start()
+    mx.profiler.marker("tick")
+    ev.stop()
+    c = mx.profiler.Counter("batches")
+    c.increment()
+    c.increment(2)
+    assert c.value == 3
+    mx.profiler.stop()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""KVStore push/pull bandwidth measurement (reference:
+``tools/bandwidth/measure.py``).
+
+Measures the aggregate bytes/s of pushpull rounds over the configured
+kvstore type -- single-process this exercises device<->host and the
+reduce path; launched under ``tools/launch.py`` with ``dist_sync`` it
+measures the cross-process (coordination service / collective) path.
+
+    python tools/bandwidth.py --size-mb 64 --rounds 10
+    python tools/launch.py -n 2 python tools/bandwidth.py --kv dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+import numpy as np                  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--kv", default="device")
+    p.add_argument("--size-mb", type=float, default=16.0)
+    p.add_argument("--rounds", type=int, default=10)
+    args = p.parse_args()
+
+    import mxnet_tpu as mx
+    mx.distributed_init()
+    kv = mx.kv.create(args.kv)
+    n = int(args.size_mb * (1 << 20) / 4)
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    grad = mx.nd.ones((n,), ctx=ctx)
+    out = mx.nd.zeros((n,), ctx=ctx)
+    kv.init("x", mx.nd.zeros((n,), ctx=ctx))
+
+    kv.pushpull("x", grad, out=out)       # warmup
+    mx.nd.waitall()
+    t0 = time.perf_counter()
+    for _ in range(args.rounds):
+        kv.pushpull("x", grad, out=out)
+    mx.nd.waitall()
+    dt = time.perf_counter() - t0
+    gb = args.size_mb / 1024 * args.rounds * 2   # push + pull
+    print("rank %d: %.2f GB moved in %.3fs -> %.2f GB/s"
+          % (kv.rank, gb, dt, gb / dt))
+
+
+if __name__ == "__main__":
+    main()

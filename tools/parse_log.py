@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Parse training logs into a metric table (reference:
+``tools/parse_log.py``): extracts epoch, train/validation metrics, and
+Speedometer samples/sec from the logging format ``callback.py`` emits.
+
+    python tools/parse_log.py train.log
+    python tools/parse_log.py train.log --format json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+_EPOCH = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w\-]+)=([\d.eE+-]+)")
+_SPEED = re.compile(
+    r"Epoch\[(\d+)\].*Speed:\s*([\d.]+)\s*samples/sec")
+_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse(lines):
+    epochs = {}
+    for line in lines:
+        m = _EPOCH.search(line)
+        if m:
+            e = int(m.group(1))
+            key = "%s-%s" % (m.group(2).lower(), m.group(3))
+            epochs.setdefault(e, {})[key] = float(m.group(4))
+            continue
+        m = _SPEED.search(line)
+        if m:
+            e = int(m.group(1))
+            d = epochs.setdefault(e, {})
+            d.setdefault("_speeds", []).append(float(m.group(2)))
+            continue
+        m = _TIME.search(line)
+        if m:
+            epochs.setdefault(int(m.group(1)), {})["time_s"] = \
+                float(m.group(2))
+    for d in epochs.values():
+        speeds = d.pop("_speeds", None)
+        if speeds:
+            d["samples_per_sec"] = sum(speeds) / len(speeds)
+    return epochs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=("table", "json"),
+                   default="table")
+    args = p.parse_args(argv)
+    with open(args.logfile) as f:
+        epochs = parse(f)
+    if args.format == "json":
+        print(json.dumps(epochs, indent=2, sort_keys=True))
+        return
+    keys = sorted({k for d in epochs.values() for k in d})
+    print("\t".join(["epoch"] + keys))
+    for e in sorted(epochs):
+        row = [str(e)] + ["%.6g" % epochs[e][k] if k in epochs[e]
+                          else "-" for k in keys]
+        print("\t".join(row))
+
+
+if __name__ == "__main__":
+    main()
