@@ -192,7 +192,12 @@ class Parameter:
                 raise MXNetError("parameter %s not initialized" % self.name)
         grad = self._data._grad
         req = self._data._grad_req
-        self._data = data if isinstance(data, NDArray) else NDArray(data)
+        new = data if isinstance(data, NDArray) else NDArray(data)
+        if self.dtype is not None and new.dtype != self.dtype:
+            # keep the declared dtype authoritative: a drifted rebind
+            # would change traced-graph dtypes mid-model downstream
+            new = new.astype(self.dtype)
+        self._data = new
         self._data._grad = grad
         self._data._grad_req = req
 

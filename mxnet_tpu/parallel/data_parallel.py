@@ -316,6 +316,14 @@ class TrainStep:
         from .. import autograd as _ag
         tr = self._trainer
         opt = tr._optimizer
+        # value dtype must match the declared Parameter dtype BEFORE
+        # optimizer states are created from it (a drifted value would
+        # bake mismatched state dtypes in for the whole run);
+        # Parameter.cast also reallocates the grad buffer
+        for p in tr._params:
+            if p._data is not None and p.dtype is not None \
+                    and p._data._data.dtype != p.dtype:
+                p.cast(p.dtype)
         self._ensure_states()
         if not isinstance(data, NDArray):
             data = NDArray(jnp.asarray(data))
@@ -366,15 +374,6 @@ class TrainStep:
         loss_scale = jnp.asarray(ls, jnp.float32)
 
         upd = tr._updater
-        # harmonize value dtype with the Parameter's declared dtype: a
-        # value that drifted (e.g. a post-initialize cast that raced a
-        # deferred materialization) would otherwise change the traced
-        # graph's dtypes mid-model
-        for n in pnames:
-            p = pmap[n]
-            if p._data is not None and p.dtype is not None \
-                    and p._data._data.dtype != p.dtype:
-                p._data._data = p._data._data.astype(p.dtype)
         pvals = {n: pmap[n]._data._data for n in pnames}
         svals = {i: jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, NDArray) else x,
