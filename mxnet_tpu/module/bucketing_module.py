@@ -39,6 +39,12 @@ class BucketingModule(BaseModule):
         return self._default_bucket_key
 
     @property
+    def bucket_keys(self):
+        """Keys with a compiled executor so far (one XLA program per
+        shape class)."""
+        return sorted(self._buckets)
+
+    @property
     def symbol(self):
         assert self.binded
         return self._curr_module.symbol
