@@ -83,6 +83,55 @@ def bench_lenet(batch_size=256):
                         batch_size, warmup=5, iters=50)
 
 
+def bench_lenet_imperative(batch_size=256, iters=30):
+    """Config 1's stated mode: NON-hybridized eager training -- every op
+    call dispatches through the persistent per-op jit cache (SURVEY §7
+    hard-part #1).  The gap to the hybridized number is dispatch
+    overhead; measured with LOCAL dispatch (CPU backend) imperative is
+    within 2x of (and can beat) hybridized, while the tunneled remote
+    chip adds a network round-trip per op call, so the on-axon ratio
+    (~10x) reflects the tunnel, not the dispatcher."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    ctx = _ctx()
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Conv2D(50, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(500, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(ctx=ctx, force_reinit=True)   # NOT hybridized
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=None)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch_size, 1, 28, 28).astype(np.float32),
+                    ctx=ctx)
+    y = mx.nd.array(rng.randint(0, 10, (batch_size,)).astype(np.float32),
+                    ctx=ctx)
+
+    def step():
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        trainer.step(batch_size)
+        return loss
+
+    for _ in range(5):
+        step()
+    float(step().asscalar())
+    t0 = time.perf_counter()
+    last = None
+    for _ in range(iters):
+        last = step()
+    float(last.asscalar())
+    return batch_size * iters / (time.perf_counter() - t0)
+
+
 def bench_resnet50(batch_size=128, dtype="float32"):
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
@@ -152,6 +201,17 @@ def main():
     results["lenet_mnist_train"] = lenet
     print(json.dumps({"metric": "lenet_mnist_train", "value": round(lenet, 1),
                       "unit": "img/s", "vs_baseline": None}))
+
+    try:
+        lenet_imp = bench_lenet_imperative(lenet_bs,
+                                           iters=30 if on_tpu else 5)
+        results["lenet_mnist_train_imperative"] = lenet_imp
+        print(json.dumps({"metric": "lenet_mnist_train_imperative",
+                          "value": round(lenet_imp, 1), "unit": "img/s",
+                          "vs_baseline": None}))
+    except Exception as e:
+        print(json.dumps({"metric": "lenet_mnist_train_imperative",
+                          "error": str(e)[:200]}))
 
     rn = bench_resnet50(rn_bs)
     results["resnet50_train_fp32"] = rn
