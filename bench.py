@@ -68,7 +68,7 @@ def _bench_train(net, loss_fn, data_shape, label_shape, n_classes,
     return batch_size * iters / dt
 
 
-def bench_lenet(batch_size=256):
+def _lenet_net():
     from mxnet_tpu import gluon
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
@@ -78,6 +78,12 @@ def bench_lenet(batch_size=256):
             gluon.nn.Flatten(),
             gluon.nn.Dense(500, activation="relu"),
             gluon.nn.Dense(10))
+    return net
+
+
+def bench_lenet(batch_size=256):
+    from mxnet_tpu import gluon
+    net = _lenet_net()
     return _bench_train(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                         (batch_size, 1, 28, 28), (batch_size,), 10,
                         batch_size, warmup=5, iters=50)
@@ -95,14 +101,7 @@ def bench_lenet_imperative(batch_size=256, iters=30):
     from mxnet_tpu import autograd, gluon
 
     ctx = _ctx()
-    net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
-            gluon.nn.MaxPool2D(2, 2),
-            gluon.nn.Conv2D(50, kernel_size=5, activation="relu"),
-            gluon.nn.MaxPool2D(2, 2),
-            gluon.nn.Flatten(),
-            gluon.nn.Dense(500, activation="relu"),
-            gluon.nn.Dense(10))
+    net = _lenet_net()
     net.initialize(ctx=ctx, force_reinit=True)   # NOT hybridized
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = gluon.Trainer(net.collect_params(), "sgd",
