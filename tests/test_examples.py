@@ -1,0 +1,43 @@
+"""Examples stay runnable (reference: CI runs example scripts).  Each
+runs as a subprocess with tiny workloads."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    return subprocess.run(
+        [sys.executable] + args, capture_output=True, text=True,
+        timeout=timeout,
+        env={**os.environ, "PYTHONPATH": REPO + os.pathsep +
+             os.environ.get("PYTHONPATH", "")})
+
+
+def test_module_mnist_example():
+    out = _run([os.path.join(REPO, "examples", "module_mnist.py"),
+                "--epochs", "1"])
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "final validation" in out.stdout
+
+
+def test_rnn_bucketing_example():
+    out = _run([os.path.join(REPO, "examples", "rnn_bucketing.py"),
+                "--epochs", "1", "--batch-size", "16"])
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "buckets compiled: [8, 16, 32]" in out.stdout
+
+
+def test_data_parallel_example():
+    out = _run([os.path.join(REPO, "examples", "data_parallel.py"),
+                "--steps", "3", "--batch-size", "32"])
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "img/s" in out.stdout
+
+
+def test_gluon_mnist_example():
+    out = _run([os.path.join(REPO, "examples", "gluon_mnist.py"),
+                "--epochs", "1", "--batch-size", "64"], timeout=540)
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert "accuracy=" in out.stdout
