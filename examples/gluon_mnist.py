@@ -37,6 +37,8 @@ def main():
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--hybridize", action="store_true")
+    p.add_argument("--max-batches", type=int, default=0,
+                   help="cap batches per epoch (0 = full epoch)")
     args = p.parse_args()
 
     ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
@@ -61,7 +63,11 @@ def main():
         metric.reset()
         tic = time.time()
         n = 0
+        nb = 0
         for data, label in train_data:
+            nb += 1
+            if args.max_batches and nb > args.max_batches:
+                break
             data = data.as_in_context(ctx)
             label = label.as_in_context(ctx)
             with autograd.record():

@@ -38,6 +38,7 @@ def test_data_parallel_example():
 
 def test_gluon_mnist_example():
     out = _run([os.path.join(REPO, "examples", "gluon_mnist.py"),
-                "--epochs", "1", "--batch-size", "64"], timeout=540)
+                "--epochs", "1", "--batch-size", "64",
+                "--max-batches", "20"], timeout=540)
     assert out.returncode == 0, out.stderr[-1500:]
     assert "accuracy=" in out.stdout
