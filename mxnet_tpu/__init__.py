@@ -72,6 +72,7 @@ from .predictor import Predictor, CompiledPredictor
 from . import visualization as viz
 visualization = viz
 from . import onnx
+from . import contrib
 from . import horovod
 from . import name
 from . import attribute

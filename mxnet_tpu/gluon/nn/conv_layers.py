@@ -31,13 +31,25 @@ class _Conv(HybridBlock):
         self._act = activation
         self._groups = groups
         self._kernel = kernel_size
+        self._layout = layout
+        # channel axis in the data layout; weight layout is derived from it
+        # (ops/nn.py::_conv_dnums): NCHW -> OIHW, NHWC -> OHWI
+        self._c_axis = layout.index("C")
+        channels_last = self._c_axis == ndim + 1
         with self.name_scope():
             if op_name == "Convolution":
-                wshape = (channels, in_channels // groups if in_channels else 0) \
-                    + tuple(kernel_size)
+                ic = in_channels // groups if in_channels else 0
+                if channels_last:
+                    wshape = (channels,) + tuple(kernel_size) + (ic,)
+                else:
+                    wshape = (channels, ic) + tuple(kernel_size)
             else:  # Deconvolution: (in, out/groups, *k)
-                wshape = (in_channels if in_channels else 0, channels // groups) \
-                    + tuple(kernel_size)
+                if channels_last:
+                    wshape = (in_channels if in_channels else 0,) \
+                        + tuple(kernel_size) + (channels // groups,)
+                else:
+                    wshape = (in_channels if in_channels else 0,
+                              channels // groups) + tuple(kernel_size)
             self.weight = self.params.get(
                 "weight", shape=wshape, init=weight_initializer,
                 allow_deferred_init=True)
@@ -49,13 +61,22 @@ class _Conv(HybridBlock):
                 self.bias = None
 
     def infer_shape(self, x):
-        c = x.shape[1]
+        c = x.shape[self._c_axis]
+        channels_last = self._c_axis == len(self._kernel) + 1
         if self._op_name == "Convolution":
-            self.weight.shape = (self._channels, c // self._groups) \
-                + tuple(self._kernel)
+            if channels_last:
+                self.weight.shape = (self._channels,) + tuple(self._kernel) \
+                    + (c // self._groups,)
+            else:
+                self.weight.shape = (self._channels, c // self._groups) \
+                    + tuple(self._kernel)
         else:
-            self.weight.shape = (c, self._channels // self._groups) \
-                + tuple(self._kernel)
+            if channels_last:
+                self.weight.shape = (c,) + tuple(self._kernel) \
+                    + (self._channels // self._groups,)
+            else:
+                self.weight.shape = (c, self._channels // self._groups) \
+                    + tuple(self._kernel)
 
     def hybrid_forward(self, F, x, weight, bias=None):
         op = getattr(F, self._op_name)
@@ -118,7 +139,8 @@ class _Pooling(HybridBlock):
         self._kwargs = {
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
-            "pooling_convention": "full" if ceil_mode else "valid"}
+            "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout}
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 

@@ -157,6 +157,62 @@ def _quantized_fully_connected(data, weight, bias, min_data, max_data,
     return acc, -out_bound, out_bound
 
 
+@register("quantized_conv",
+          args=("data", "weight", "bias", "min_data", "max_data",
+                "min_weight", "max_weight", "min_bias", "max_bias"),
+          aliases=("_contrib_quantized_conv",))
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, min_bias, max_bias, kernel=(), stride=(),
+                    dilate=(), pad=(), num_filter=0, num_group=1,
+                    no_bias=True, layout="NCHW"):
+    """int8 x int8 -> int32 convolution (reference:
+    ``quantized_conv``).  The int8 contraction rides the MXU with an
+    int32 accumulator (``preferred_element_type``); output carries the
+    (min, max) range convention of the quantized family."""
+    from .nn import _conv_dnums, _pair as _p
+    nsp = data.ndim - 2
+    stride = _p(stride, nsp) if stride else (1,) * nsp
+    dilate = _p(dilate, nsp) if dilate else (1,) * nsp
+    pad = _p(pad, nsp) if pad else (0,) * nsp
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dnums(data.ndim, layout))
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int32), weight.astype(jnp.int32),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    if bias is not None and not no_bias:
+        from .nn import _bias_bshape
+        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        scale_ratio = sb / jnp.maximum(sd * sw, 1e-20)
+        bshape = _bias_bshape(data.ndim, layout)
+        acc = acc + jnp.round(bias.astype(jnp.float32).reshape(bshape)
+                              * scale_ratio).astype(jnp.int32)
+    out_bound = 127.0 * 127.0 * sd * sw
+    return acc, -out_bound, out_bound
+
+
+@register("quantized_pooling", args=("data", "min_data", "max_data"),
+          aliases=("_contrib_quantized_pooling",))
+def _quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
+                       stride=(), pad=(), global_pool=False,
+                       count_include_pad=True,
+                       pooling_convention="valid", layout="NCHW"):
+    """int8 pooling passthrough (reference: ``quantized_pooling``): pool
+    in the integer domain, range unchanged."""
+    from .registry import get_op
+    _pooling = get_op("Pooling").fcompute
+    out = _pooling(data.astype(jnp.float32), kernel=kernel,
+                   pool_type=pool_type, stride=stride, pad=pad,
+                   global_pool=global_pool,
+                   count_include_pad=count_include_pad,
+                   pooling_convention=pooling_convention, layout=layout)
+    return jnp.round(out).astype(data.dtype), min_data, max_data
+
+
 # ----------------------------------------------------------------------
 # Boxes / ROI (reference: src/operator/contrib/{bounding_box,roi_align}.cc,
 # src/operator/roi_pooling.cc)

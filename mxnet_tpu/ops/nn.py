@@ -55,14 +55,29 @@ def _fully_connected(data, weight, bias, num_hidden=0, no_bias=False, flatten=Tr
     return out
 
 
-def _conv_dnums(ndim):
-    if ndim == 3:
-        return ("NCH", "OIH", "NCH")
-    if ndim == 4:
-        return ("NCHW", "OIHW", "NCHW")
-    if ndim == 5:
-        return ("NCDHW", "OIDHW", "NCDHW")
-    raise MXNetError("Convolution: unsupported input rank %d" % ndim)
+_DEFAULT_LAYOUTS = {3: "NCH", 4: "NCHW", 5: "NCDHW"}
+
+
+def _conv_dnums(ndim, layout=None):
+    """Dimension-number strings for a data layout.
+
+    The weight layout follows the reference's convention: the data layout
+    string with N->O and C->I (NCHW -> OIHW, NHWC -> OHWI, ...).
+    """
+    if not layout:
+        layout = _DEFAULT_LAYOUTS.get(ndim)
+    if layout is None or len(layout) != ndim:
+        raise MXNetError("Convolution: unsupported input rank %d / layout %r"
+                         % (ndim, layout))
+    rhs = layout.replace("N", "O").replace("C", "I")
+    return (layout, rhs, layout)
+
+
+def _bias_bshape(ndim, layout):
+    c_axis = layout.index("C") if layout else 1
+    shape = [1] * ndim
+    shape[c_axis] = -1
+    return tuple(shape)
 
 
 @register("Convolution", args=("data", "weight", "bias"))
@@ -71,20 +86,29 @@ def _convolution(data, weight, bias, kernel=(), stride=(), dilate=(), pad=(),
     """N-D convolution (reference: ``src/operator/nn/convolution.cc``).
 
     Lowers to one ``lax.conv_general_dilated`` -- XLA tiles it onto the MXU
-    (the reference dispatches to cuDNN ``cudnn_convolution-inl.h``).  Layout
-    is logical NCHW; XLA's layout assignment picks the physical TPU layout.
+    (the reference dispatches to cuDNN ``cudnn_convolution-inl.h``).
+
+    ``layout`` follows the reference's semantics: it names the data (and
+    derived weight) layout, e.g. NCHW (weight OIHW) or NHWC (weight OHWI).
+    On TPU channels-last is the fast path -- the channel dim lands in the
+    128-wide lane dimension of the (8, 128) vector tiles, so 56x56
+    activations don't pad the 128-lane minor dim the way W=56 does in
+    NCHW.
     """
     nsp = data.ndim - 2
     stride = _pair(stride, nsp) if stride else (1,) * nsp
     dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
     pad = _pair(pad, nsp) if pad else (0,) * nsp
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _conv_dnums(data.ndim))
+    if layout and len(layout) != data.ndim:
+        layout = _DEFAULT_LAYOUTS.get(data.ndim)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _conv_dnums(data.ndim, layout))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group)
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nsp)
+        out = out + bias.reshape(_bias_bshape(data.ndim, layout))
     return out
 
 
@@ -96,6 +120,26 @@ def _deconvolution(data, weight, bias, kernel=(), stride=(), dilate=(), pad=(),
     Implemented as the gradient of Convolution (lhs-dilated conv), matching
     the reference's definition.  Weight shape (in_c, out_c/groups, *k).
     """
+    nsp = data.ndim - 2
+    if layout and len(layout) == data.ndim \
+            and layout.index("C") == data.ndim - 1:
+        # channels-last: run the channels-first path on transposed operands
+        # (deconv is never the hot op; correctness over layout tuning)
+        perm = (0, data.ndim - 1) + tuple(range(1, data.ndim - 1))
+        inv = (0,) + tuple(range(2, data.ndim)) + (1,)
+        wperm = (0, weight.ndim - 1) + tuple(range(1, weight.ndim - 1))
+        out = _deconv_channels_first(
+            jnp.transpose(data, perm), jnp.transpose(weight, wperm), bias,
+            stride=stride, dilate=dilate, pad=pad, adj=adj,
+            num_group=num_group, no_bias=no_bias)
+        return jnp.transpose(out, inv)
+    return _deconv_channels_first(data, weight, bias, stride=stride,
+                                  dilate=dilate, pad=pad, adj=adj,
+                                  num_group=num_group, no_bias=no_bias)
+
+
+def _deconv_channels_first(data, weight, bias, stride=(), dilate=(), pad=(),
+                           adj=(), num_group=1, no_bias=True):
     nsp = data.ndim - 2
     stride = _pair(stride, nsp) if stride else (1,) * nsp
     dilate = _pair(dilate, nsp) if dilate else (1,) * nsp
@@ -127,29 +171,43 @@ def _deconvolution(data, weight, bias, kernel=(), stride=(), dilate=(), pad=(),
 
 @register("Pooling", args=("data",))
 def _pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
-             global_pool=False, count_include_pad=True, pooling_convention="valid"):
-    """Max/avg/sum/lp pooling (reference: ``src/operator/nn/pooling.cc``)."""
+             global_pool=False, count_include_pad=True,
+             pooling_convention="valid", layout="NCHW"):
+    """Max/avg/sum/lp pooling (reference: ``src/operator/nn/pooling.cc``).
+
+    ``layout`` names the data layout (NCHW/NHWC/...); the pooling window
+    spans its spatial dims.
+    """
     nsp = data.ndim - 2
+    if not layout or len(layout) != data.ndim:
+        layout = _DEFAULT_LAYOUTS.get(data.ndim, "NCHW")
+    sp_axes = [i for i, c in enumerate(layout) if c not in ("N", "C")]
+    sp_sizes = [data.shape[i] for i in sp_axes]
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = tuple(sp_sizes)
         stride = (1,) * nsp
         pad = (0,) * nsp
     else:
         kernel = _pair(kernel, nsp)
         stride = _pair(stride, nsp) if stride else (1,) * nsp
         pad = _pair(pad, nsp) if pad else (0,) * nsp
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    window = [1] * data.ndim
+    strides = [1] * data.ndim
+    padding = [(0, 0)] * data.ndim
+    for j, ax in enumerate(sp_axes):
+        window[ax] = kernel[j]
+        strides[ax] = stride[j]
+        padding[ax] = (pad[j], pad[j])
     if pooling_convention == "full":
         # ceil-mode: extend right/bottom padding so ragged edges are kept
-        extra = []
-        for i in range(nsp):
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
-            rem = size % stride[i]
-            extra.append(stride[i] - rem if rem else 0)
-        padding = ((0, 0), (0, 0)) + tuple(
-            (p, p + e) for p, e in zip(pad, extra))
+        for j, ax in enumerate(sp_axes):
+            size = sp_sizes[j] + 2 * pad[j] - kernel[j]
+            rem = size % stride[j]
+            extra = stride[j] - rem if rem else 0
+            padding[ax] = (pad[j], pad[j] + extra)
+    window = tuple(window)
+    strides = tuple(strides)
+    padding = tuple(padding)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, padding)

@@ -311,6 +311,171 @@ class TrainStep:
             jit_kwargs["donate_argnums"] = (0, 1)
         return jax.jit(step_fn, **jit_kwargs), idxs, pnames, pmap
 
+    # -- multi-step scan ----------------------------------------------
+    def _build_scan(self, ivals, training):
+        """Compile a ``lax.scan`` over K training steps: one dispatch runs
+        K full fwd+bwd+update iterations on stacked batches (K, B, ...).
+
+        TPU-idiomatic epoch inner loop: removes per-step host dispatch
+        entirely (the reference's analog is engine-queued bulk execution;
+        here the loop itself is on device).
+        """
+        fn_single, idxs, pnames, pmap = self._build(
+            [NDArray(ivals[0]._data[0]), NDArray(ivals[1]._data[0])],
+            training)
+        aux_names = None
+
+        def scan_fn(pvals, svals, datas, labels, rng, t0, lrs, wds,
+                    rescale, loss_scale):
+            k = datas.shape[0]
+
+            def body(carry, xs):
+                pv, sv, t = carry
+                data, label, key = xs
+                new_w, new_s, aux, mean_loss, _fin = fn_single(
+                    pv, sv, data, label, key, t, lrs, wds, rescale,
+                    loss_scale)
+                # thread updated BN running stats back in for the next step
+                new_w = dict(new_w)
+                for n, v in aux.items():
+                    new_w[n] = v
+                return (new_w, new_s, t + 1), mean_loss
+
+            keys = jax.random.split(rng, k)
+            (pv, sv, t), losses = jax.lax.scan(
+                body, (pvals, svals, t0), (datas, labels, keys))
+            return pv, sv, t, losses
+
+        jit_kwargs = {}
+        if self._mesh is not None:
+            mesh = self._mesh
+            rep = _replicated(mesh)
+            data_sh = _batch_sharding(mesh, ivals[0]._data.ndim,
+                                      self._batch_axis + 1, self._axis_name)
+            label_sh = _batch_sharding(mesh, ivals[1]._data.ndim, 1,
+                                       self._axis_name)
+            jit_kwargs["in_shardings"] = (
+                None, None, data_sh, label_sh, rep, rep, rep, rep, rep, rep)
+        if self._donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+        return jax.jit(scan_fn, **jit_kwargs), idxs, pnames, pmap
+
+    def run_steps(self, data, label, batch_size=None):
+        """Run K training steps in ONE compiled dispatch.
+
+        ``data``/``label`` carry a leading steps axis: (K, B, ...).
+        Returns the per-step mean losses as an NDArray of shape (K,).
+        BatchNorm running stats, optimizer state, and the step counter all
+        thread through the on-device loop.
+        """
+        from .. import amp as _amp
+        tr = self._trainer
+        opt = tr._optimizer
+        if getattr(tr, "_amp_loss_scaler", None) is not None:
+            raise MXNetError(
+                "run_steps does not support fp16 dynamic loss scaling "
+                "(the scaler's growth/backoff counters live on the host); "
+                "use bf16 AMP or per-step __call__ for fp16")
+        for p in tr._params:
+            if p._data is not None and p.dtype is not None \
+                    and p._data._data.dtype != p.dtype:
+                p.cast(p.dtype)
+        self._ensure_states()
+        if not isinstance(data, NDArray):
+            data = NDArray(jnp.asarray(data))
+        if not isinstance(label, NDArray):
+            label = NDArray(jnp.asarray(label))
+        if self._mesh is not None and data._data.ndim:
+            # leading axis is the step index; batch axis shifts right by 1
+            want = _batch_sharding(self._mesh, data._data.ndim,
+                                   self._batch_axis + 1, self._axis_name)
+            if not data._data.sharding.is_equivalent_to(want,
+                                                        data._data.ndim):
+                data = NDArray(jax.device_put(data._data, want))
+                lsh = _batch_sharding(self._mesh, label._data.ndim, 1,
+                                      self._axis_name)
+                label = NDArray(jax.device_put(label._data, lsh))
+        if any(p._deferred_init is not None
+               for p in self._block._all_params()):
+            from .. import autograd as _ag
+            with _ag.pause():
+                self._block(NDArray(data._data[0]))
+            self._ensure_states()
+        k = data.shape[0]
+        key = ("scan", tuple(data.shape), str(data.dtype),
+               tuple(label.shape), str(label.dtype), _amp.policy_token())
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build_scan([data, label], True)
+            self._cache[key] = entry
+        fn, idxs, pnames, pmap = entry
+
+        t_start = opt._index_update_count.get(
+            idxs[0], opt.begin_num_update) + 1 if idxs else opt.num_update
+        # lr/wd are read from the schedule at the BLOCK START and held for
+        # the K in-scan steps (the schedule is host-side Python, so it
+        # cannot be traced per step); callers with fast-moving schedules
+        # should pick K accordingly
+        num_update_at_start = max(opt.num_update, t_start)
+        saved_num_update = opt.num_update
+        opt.num_update = num_update_at_start
+        lrs = jnp.asarray([opt._get_lr(i) for i in idxs], jnp.float32)
+        wds = jnp.asarray([opt._get_wd(i) for i in idxs], jnp.float32)
+        opt.num_update = saved_num_update
+        for i in idxs:
+            opt._index_update_count[i] = \
+                opt._index_update_count.get(i, opt.begin_num_update) + k
+            opt.num_update = max(opt._index_update_count[i], opt.num_update)
+        t = jnp.asarray(t_start, jnp.int32)
+        bs = batch_size if batch_size is not None \
+            else data.shape[self._batch_axis + 1]
+        rescale = jnp.asarray(tr._scale / bs, jnp.float32)
+        loss_scale = jnp.asarray(1.0, jnp.float32)
+        upd = tr._updater
+        pvals = {n: pmap[n]._data._data for n in pnames}
+        svals = {i: jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, NDArray) else x,
+            upd.states.get(i),
+            is_leaf=lambda x: isinstance(x, NDArray) or x is None)
+            for i in idxs}
+        rng = _random_mod.next_key()
+        args = (pvals, svals, data._data, label._data, rng, t, lrs, wds,
+                rescale, loss_scale)
+        self._last_call = (fn, jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
+        new_w, new_s, _t, losses = fn(*args)
+        for n in pnames:
+            pmap[n]._data._data = new_w[n]
+        for i in idxs:
+            s = upd.states.get(i)
+            flat_new = jax.tree_util.tree_leaves(new_s[i])
+            for leaf, nv in zip(_state_leaves(s), flat_new):
+                leaf._data = nv
+        # aux (running stats) were threaded inside new_w; rebind Parameters
+        for p in self._block._all_params():
+            if p.name in pnames and p.grad_req == "null" \
+                    and p._data is not None:
+                grad = p._data._grad
+                p._data = NDArray(new_w[p.name])
+                p._data._grad = grad
+        return NDArray(losses)
+
+    def cost_analysis(self):
+        """XLA's cost analysis of the most recently dispatched compiled
+        program -- ``{"flops": ..., "bytes accessed": ..., ...}`` or None.
+        Powers the bench's MFU report.  Cheap after the first call: the
+        lowering hits the jit compile cache."""
+        if getattr(self, "_last_call", None) is None:
+            return None
+        fn, arg_shapes = self._last_call
+        try:
+            ca = fn.lower(*arg_shapes).compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            return dict(ca)
+        except Exception:
+            return None
+
     # -- call ----------------------------------------------------------
     def __call__(self, data, label, batch_size=None):
         from .. import autograd as _ag
@@ -382,9 +547,11 @@ class TrainStep:
             for i in idxs}
         rng = _random_mod.next_key()
 
-        new_w, new_s, aux, mean_loss, all_finite = fn(
-            pvals, svals, data._data, label._data, rng, t, lrs, wds,
-            rescale, loss_scale)
+        args = (pvals, svals, data._data, label._data, rng, t, lrs, wds,
+                rescale, loss_scale)
+        self._last_call = (fn, jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
+        new_w, new_s, aux, mean_loss, all_finite = fn(*args)
         if scaler is not None:
             # host sync only in fp16 mode: the scaler's growth/backoff
             # counters live on the host (reference LossScaler semantics)

@@ -350,7 +350,8 @@ def _probe_num_outputs(op, node):
     if op.name == "linalg_svd":
         return 3
     if op.name in ("quantize", "quantize_v2", "requantize",
-                   "quantized_fully_connected"):
+                   "quantized_fully_connected", "quantized_conv",
+                   "quantized_pooling"):
         return 3
     return 1
 

@@ -1,0 +1,118 @@
+"""Channels-last (NHWC) layout support through conv/pool/BN and the
+Gluon layers (reference: ``layout`` parameter of ``Convolution``,
+``Pooling``; ``BatchNorm(axis=...)``).
+
+A channels-last network with weights permuted from a channels-first one
+must produce identical outputs -- the TPU-relevant property is that the
+layout only permutes the logical view, never the math.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def _small_net(layout):
+    c_axis = layout.index("C")
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, layout=layout,
+                      activation="relu"),
+            nn.BatchNorm(axis=c_axis),
+            nn.MaxPool2D(2, 2, layout=layout),
+            nn.Conv2D(16, kernel_size=3, strides=2, padding=1,
+                      use_bias=False, layout=layout),
+            nn.BatchNorm(axis=c_axis),
+            nn.GlobalAvgPool2D(layout=layout),
+            nn.Flatten(),
+            nn.Dense(5))
+    return net
+
+
+def test_nhwc_matches_nchw():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+
+    a = _small_net("NCHW")
+    a.initialize(ctx=mx.cpu())
+    a.hybridize()
+    ya = a(mx.nd.array(x)).asnumpy()
+
+    b = _small_net("NHWC")
+    b.initialize(ctx=mx.cpu())
+    b.hybridize()
+    xb = mx.nd.array(np.transpose(x, (0, 2, 3, 1)))
+    b(xb)  # materialize deferred shapes
+    for (na, pa), (_, pb) in zip(sorted(a.collect_params().items()),
+                                 sorted(b.collect_params().items())):
+        w = pa.data().asnumpy()
+        # conv weights go OIHW -> OHWI (shape compare alone is ambiguous
+        # when I == kh == kw)
+        if w.ndim == 4 and "conv" in na:
+            w = np.transpose(w, (0, 2, 3, 1))
+        assert pb.shape == w.shape
+        pb.set_data(mx.nd.array(w))
+    yb = b(xb).asnumpy()
+    np.testing.assert_allclose(ya, yb, rtol=1e-5, atol=1e-5)
+
+
+def test_nhwc_train_step():
+    net = _small_net("NHWC")
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    from mxnet_tpu.parallel import TrainStep
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer,
+                     mesh=None)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 16, 16, 3).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 5, (8,)).astype(np.float32))
+    l0 = float(step(x, y).asscalar())
+    for _ in range(8):
+        l1 = float(step(x, y).asscalar())
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_pooling_nhwc_matches_nchw():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 9, 9).astype(np.float32)
+    for pool_type in ("max", "avg"):
+        for ceil_mode in (False, True):
+            a = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2),
+                              pad=(1, 1), pool_type=pool_type,
+                              pooling_convention="full" if ceil_mode
+                              else "valid").asnumpy()
+            b = mx.nd.Pooling(
+                mx.nd.array(np.transpose(x, (0, 2, 3, 1))), kernel=(3, 3),
+                stride=(2, 2), pad=(1, 1), pool_type=pool_type,
+                pooling_convention="full" if ceil_mode else "valid",
+                layout="NHWC").asnumpy()
+            np.testing.assert_allclose(a, np.transpose(b, (0, 3, 1, 2)),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_conv_transpose_nhwc_matches_nchw():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 6).astype(np.float32)  # (in, kh, kw, out)
+    out_nhwc = mx.nd.Deconvolution(
+        mx.nd.array(np.transpose(x, (0, 2, 3, 1))), mx.nd.array(w), None,
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), adj=(1, 1), num_filter=6,
+        no_bias=True, layout="NHWC").asnumpy()
+    out_nchw = mx.nd.Deconvolution(
+        mx.nd.array(x), mx.nd.array(np.transpose(w, (0, 3, 1, 2))), None,
+        kernel=(3, 3), stride=(2, 2), pad=(1, 1), adj=(1, 1), num_filter=6,
+        no_bias=True).asnumpy()
+    np.testing.assert_allclose(np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               out_nchw, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_layout_kwarg():
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    net = resnet18_v1(layout="NHWC")
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    out = net(mx.nd.zeros((1, 32, 32, 3)))
+    assert out.shape == (1, 1000)

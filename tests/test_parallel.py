@@ -284,3 +284,65 @@ def test_ring_attention_composes_with_dp():
     ref = _attention_reference(qn, kn, vn, True, 1.0 / np.sqrt(d))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_run_steps_matches_sequential():
+    """The compiled K-step scan (TrainStep.run_steps) must reproduce K
+    sequential single-dispatch steps exactly: losses, parameters,
+    optimizer state, and BN running stats all thread on device."""
+    def mknet():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(10))
+        net.initialize(ctx=mx.cpu())
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9},
+                           kvstore=None)
+        return net, TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              tr, mesh=None)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8, 1, 8, 8).astype(np.float32)
+    y = rng.randint(0, 10, (3, 8)).astype(np.float32)
+
+    net_a, step_a = mknet()
+    net_b, step_b = mknet()
+    net_a(mx.nd.array(x[0]))
+    net_b(mx.nd.array(x[0]))
+    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                sorted(net_b.collect_params().items())):
+        pb.set_data(mx.nd.array(pa.data().asnumpy()))
+
+    ref = [float(step_a(mx.nd.array(x[i]), mx.nd.array(y[i])).asscalar())
+           for i in range(3)]
+    losses = step_b.run_steps(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
+                                sorted(net_b.collect_params().items())):
+        np.testing.assert_allclose(pa.data().asnumpy(),
+                                   pb.data().asnumpy(), rtol=2e-4,
+                                   atol=1e-5)
+    ca = step_b.cost_analysis()
+    assert ca is None or ca.get("flops", 0) > 0
+
+
+def test_run_steps_sharded_mesh():
+    """run_steps over a dp mesh: batches shard, params stay replicated."""
+    mesh = _mesh(4)
+    net = _small_net(3)
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr,
+                     mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2, 8, 6).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, (2, 8)).astype(np.float32))
+    losses = step.run_steps(x, y).asnumpy()
+    assert losses.shape == (2,) and np.isfinite(losses).all()
+    for p in net.collect_params().values():
+        arr = p.data()._data
+        assert len(arr.sharding.device_set) == 4

@@ -1,0 +1,140 @@
+"""Post-training int8 quantization workflow (reference:
+``mx.contrib.quantization :: quantize_model, calibrate``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib.quantization import (calibrate, quantize_graph,
+                                            quantize_model)
+
+
+def _export_sym(net, x):
+    """Trace a hybrid block to (sym, arg_params, aux_params)."""
+    net(mx.nd.array(x))
+    sym = net(mx.sym.var("data"))
+    arg, aux = {}, {}
+    for p in net._all_params():
+        if p._data is None:
+            continue
+        (aux if p._grad_req == "null" else arg)[p.name] = p.data()
+    return sym, arg, aux
+
+
+def _eval(sym, arg, aux, x):
+    feeds = dict(arg)
+    feeds.update(aux)
+    feeds["data"] = mx.nd.array(x)
+    out = sym.eval(**feeds)
+    return (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+
+
+def _lenet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    return net
+
+
+def test_quantized_graph_close_to_fp32():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 1, 12, 12).astype(np.float32)
+    net = _lenet()
+    sym, arg, aux = _export_sym(net, x)
+    want = _eval(sym, arg, aux, x)
+
+    for mode in ("naive", "entropy"):
+        qsym, qarg, qaux = quantize_model(
+            sym, arg, aux, calib_mode=mode,
+            calib_data=[x, rng.randn(4, 1, 12, 12).astype(np.float32)])
+        got = _eval(qsym, qarg, qaux, x)
+        assert got.shape == want.shape
+        # int8 sim: expect close-but-not-exact
+        scale = np.abs(want).max() or 1.0
+        assert np.abs(got - want).max() / scale < 0.1, mode
+
+
+def test_calibrate_thresholds():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 1, 12, 12).astype(np.float32)
+    net = _lenet()
+    sym, arg, aux = _export_sym(net, x)
+    th = calibrate(sym, arg, aux, [x], calib_mode="naive")
+    assert th, "no thresholds collected"
+    for lo, hi in th.values():
+        assert lo == -hi and hi > 0
+    th_e = calibrate(sym, arg, aux, [x], calib_mode="entropy")
+    assert set(th_e) == set(th)
+    for k in th:
+        assert 0 < th_e[k][1] <= th[k][1] * 1.001
+
+
+def test_excluded_sym_names():
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 1, 12, 12).astype(np.float32)
+    net = _lenet()
+    sym, arg, aux = _export_sym(net, x)
+    conv_names = [n.name for n in sym._topo() if n.op == "Convolution"]
+    qsym, qarg, _ = quantize_graph(sym, arg, aux, {},
+                                   excluded_sym_names=tuple(conv_names))
+    ops = [n.op for n in qsym._topo()]
+    assert "Convolution" in ops           # excluded stays fp32
+    assert "quantized_fully_connected" in ops
+
+
+def test_quantize_model_validations():
+    net = _lenet()
+    x = np.zeros((2, 1, 12, 12), np.float32)
+    sym, arg, aux = _export_sym(net, x)
+    with pytest.raises(MXNetError):
+        quantize_model(sym, arg, aux, calib_mode="entropy",
+                       calib_data=None)
+    with pytest.raises(MXNetError):
+        quantize_model(sym, arg, aux, quantized_dtype="uint8",
+                       calib_mode="none")
+
+
+def test_mnist_accuracy_drop_below_1pct():
+    """The reference's acceptance bar: int8 accuracy within 1% of fp32
+    on the MNIST-style classification task (synthetic digits here; the
+    separable structure mirrors the example pipeline)."""
+    rng = np.random.RandomState(3)
+    n_class, n, d = 4, 256, (1, 12, 12)
+    protos = rng.randn(n_class, *d).astype(np.float32) * 2.0
+    ys = rng.randint(0, n_class, (n,))
+    xs = protos[ys] + rng.randn(n, *d).astype(np.float32) * 0.7
+
+    net = _lenet()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3}, kvstore=None)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    from mxnet_tpu import autograd
+    bs = 32
+    net(mx.nd.array(xs[:bs]))
+    for epoch in range(6):
+        for i in range(0, n, bs):
+            xb = mx.nd.array(xs[i:i + bs])
+            yb = mx.nd.array(ys[i:i + bs].astype(np.float32))
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(bs)
+
+    sym, arg, aux = _export_sym(net, xs[:bs])
+    fp32_out = _eval(sym, arg, aux, xs)
+    fp32_acc = float((fp32_out.argmax(1) == ys).mean())
+    assert fp32_acc > 0.9, "fp32 net failed to train (acc %.2f)" % fp32_acc
+
+    qsym, qarg, qaux = quantize_model(
+        sym, arg, aux, calib_mode="entropy",
+        calib_data=[xs[i:i + bs] for i in range(0, 128, bs)])
+    q_out = _eval(qsym, qarg, qaux, xs)
+    q_acc = float((q_out.argmax(1) == ys).mean())
+    assert fp32_acc - q_acc < 0.01, \
+        "int8 accuracy dropped %.3f -> %.3f" % (fp32_acc, q_acc)

@@ -30,8 +30,11 @@ def test_plot_network_gated_or_works():
         assert "graphviz" in str(e)
 
 
-def test_onnx_gated():
-    with pytest.raises(mx.MXNetError, match="onnx"):
-        mx.onnx.export_model(_net(), {})
-    with pytest.raises(mx.MXNetError, match="onnx"):
-        mx.onnx.import_model("x.onnx")
+def test_onnx_error_paths():
+    # real converter now (tests/test_onnx.py): unsupported op -> clean
+    # MXNetError, missing file -> FileNotFoundError
+    bad = sym.SoftmaxOutput(sym.var("data"), name="softmax")
+    with pytest.raises(mx.MXNetError, match="no converter"):
+        mx.onnx.export_model(bad, {}, onnx_file_path="/tmp/_gone.onnx")
+    with pytest.raises(FileNotFoundError):
+        mx.onnx.import_model("/tmp/_does_not_exist.onnx")
