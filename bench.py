@@ -270,6 +270,143 @@ def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
     return batch_size * seq_len * iters / dt
 
 
+def _build_rec(path, n, fmt="jpg", hw=256, crop=224, seed=0):
+    """Synthetic .rec dataset for the pipeline benchmarks."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (hw, hw, 3), dtype=np.uint8)
+        header = recordio.IRHeader(0, float(i % 1000), i, 0)
+        if fmt == "raw":
+            rec.write_idx(i, recordio.pack(
+                header, img[:crop, :crop].tobytes()))
+        else:
+            rec.write_idx(i, recordio.pack_img(header, img, quality=90))
+    rec.close()
+    return path + ".rec"
+
+
+def bench_pipeline(n=512, batch_size=64, threads=2):
+    """Input pipeline host throughput (reference bar:
+    ``iter_image_recordio_2.cc`` threaded decode).  Returns
+    (jpeg_img_per_s, raw_uint8_img_per_s); numbers are per-host -- this
+    box has os.cpu_count()==1 core, so multiply by cores for a real
+    host."""
+    import shutil
+    import tempfile
+    from mxnet_tpu.image import ImageIter
+    tmp = tempfile.mkdtemp(prefix="mxtpu_bench_rec_")
+    try:
+        out = []
+        for fmt, dtype in (("jpg", "float32"), ("raw", "uint8")):
+            rec = _build_rec(_os.path.join(tmp, fmt), n, fmt)
+            it = ImageIter(batch_size, (3, 224, 224), path_imgrec=rec,
+                           preprocess_threads=threads, dtype=dtype)
+            count = 0
+            t0 = time.perf_counter()
+            for _ in range(3):
+                it.reset()
+                try:
+                    while True:
+                        d, _l, _pad = it.next_np()
+                        count += d.shape[0]
+                except StopIteration:
+                    pass
+            out.append(count / (time.perf_counter() - t0))
+        return tuple(out)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
+                       epochs=3):
+    """End-to-end ResNet-50 training fed by the REAL input pipeline
+    (raw-record uint8 decode through ImageIter), not synthetic tensors.
+
+    The decoded dataset is staged onto the device in ONE transfer
+    BEFORE training starts, then every epoch trains from the staged
+    uint8 batches with on-device slice + cast.  The timed window
+    includes the decode and the staging transfer.
+
+    Why not per-batch host feeding: measured on the axon tunnel, any
+    host->device transfer issued after the training program has run
+    collapses to ~10 MB/s (idle-process H2D is ~0.7-1.6 GB/s; see
+    docs/perf_resnet50.md) -- an environment pathology, not a pipeline
+    property.  On a PCIe-local host the producer/consumer overlap is
+    the normal mode; here the bench measures what the tunnel admits
+    while still exercising decode -> stage -> train end to end.
+    """
+    import contextlib
+    import shutil
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.image import ImageIter
+    from mxnet_tpu.parallel import TrainStep
+
+    ctx = _ctx()
+    tmp = tempfile.mkdtemp(prefix="mxtpu_bench_e2e_")
+    rec = _build_rec(_os.path.join(tmp, "train"), n_images, "raw")
+    it = ImageIter(batch_size, (3, 224, 224), path_imgrec=rec,
+                   preprocess_threads=0, dtype="uint8")
+
+    import jax
+    import jax.numpy as jnp
+    dev = jax.devices()[0] if mx.num_tpus() else jax.devices("cpu")[0]
+    compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    pick = jax.jit(lambda s, i: jax.lax.dynamic_index_in_dim(
+        s, i, 0, keepdims=False).astype(compute_dtype))
+
+    n_batches = n_images // batch_size
+    host = np.empty((n_batches, batch_size, 3, 224, 224), np.uint8)
+    host_labels = np.empty((n_batches, batch_size), np.float32)
+
+    t_start = time.perf_counter()
+    it.reset()
+    for k in range(n_batches):
+        _d, l, _pad = it.next_np(out=host[k])
+        host_labels[k] = l
+    it._rec.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+    staged = jax.device_put(host, dev)
+    labels_dev = jax.device_put(host_labels, dev)
+    jax.block_until_ready(staged)
+    t_staged = time.perf_counter()
+
+    net = resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9},
+                            kvstore=None)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer,
+                     mesh=None)
+    amp_ctx = amp.scope(dtype) if dtype != "float32" \
+        else contextlib.nullcontext()
+    with amp_ctx:
+        xw = mx.nd.NDArray(pick(staged, 0))
+        yw = mx.nd.NDArray(labels_dev[0])
+        for _ in range(3):
+            step(xw, yw)
+        float(step(xw, yw).asscalar())
+
+        count = 0
+        last = None
+        t0 = time.perf_counter()
+        for _ in range(epochs):
+            for k in range(n_batches):
+                x = mx.nd.NDArray(pick(staged, k))
+                y = mx.nd.NDArray(labels_dev[k])
+                last = step(x, y)
+                count += batch_size
+        float(last.asscalar())
+        dt = (time.perf_counter() - t0) + (t_staged - t_start)
+    return count / dt
+
+
 def main():
     import mxnet_tpu as mx
     results = {}
@@ -350,6 +487,33 @@ def main():
     except Exception as e:
         print(json.dumps({"metric": "resnet50_imagenet_train_bf16_scan",
                           "error": str(e)[:200]}))
+
+    try:
+        jpeg_ips, raw_ips = bench_pipeline(
+            n=512 if on_tpu else 128, threads=2)
+        print(json.dumps({"metric": "pipeline_jpeg_decode",
+                          "value": round(jpeg_ips, 1),
+                          "unit": "img/s/host",
+                          "host_cores": _os.cpu_count(),
+                          "vs_baseline": None}))
+        print(json.dumps({"metric": "pipeline_raw_uint8",
+                          "value": round(raw_ips, 1),
+                          "unit": "img/s/host",
+                          "host_cores": _os.cpu_count(),
+                          "vs_baseline": None}))
+    except Exception as e:
+        print(json.dumps({"metric": "pipeline", "error": str(e)[:200]}))
+
+    if on_tpu:
+        try:
+            e2e = bench_resnet50_e2e(rn_bs * 2, dtype="bfloat16")
+            results["resnet50_e2e"] = e2e
+            print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
+                              "value": round(e2e, 1), "unit": "img/s",
+                              "vs_baseline": None}))
+        except Exception as e:
+            print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
+                              "error": str(e)[:200]}))
 
     # bs=128 is the single-chip throughput knee (measured: 38k tok/s at
     # bs16 -> 116k at bs128, flat beyond)

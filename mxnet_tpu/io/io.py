@@ -274,7 +274,8 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
                           rand_mirror=rand_mirror)
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
                       aug_list=aug, shuffle=shuffle, num_parts=num_parts,
-                      part_index=part_index)
+                      part_index=part_index,
+                      preprocess_threads=preprocess_threads)
 
     mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
     std = np.array([std_r or 1, std_g or 1, std_b or 1],
@@ -288,11 +289,11 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
             inner.reset()
 
         def next(self):
-            batch = inner.next()
-            d = batch.data[0].asnumpy()
+            d, labels, pad = inner.next_np()
             if d.shape[1] == 3 and (mean.any() or (std != 1).any()):
                 d = (d - mean) / std
-            return DataBatch(data=[array(d)], label=batch.label)
+            return DataBatch(data=[array(d)], label=[array(labels)],
+                             pad=pad)
 
         @property
         def provide_data(self):

@@ -50,7 +50,7 @@ MX2ONNX_OP = {
     "elemwise_div": "Div", "broadcast_add": "Add", "broadcast_sub": "Sub",
     "broadcast_mul": "Mul", "broadcast_div": "Div",
     "broadcast_power": "Pow", "broadcast_maximum": "Max",
-    "broadcast_minimum": "Min", "dot": "MatMul", "batch_dot": "MatMul",
+    "broadcast_minimum": "Min", "matmul": "MatMul",
     "add_n": "Sum", "Flatten": "Flatten",
 }
 
@@ -276,6 +276,20 @@ class _Exporter:
         self.add_node("Unsqueeze", [self.in_name(node, 0), ax],
                       [node.name], node.name)
 
+    def dot(self, node):
+        # ONNX MatMul has numpy semantics and no transpose attrs; only
+        # the untransposed form maps losslessly (mx dot's ND behavior is
+        # tensordot(axes=1), which MatMul matches for rank <= 2; rank is
+        # unknown at export time, so transposes are rejected, not
+        # silently dropped)
+        if _attr(node, "transpose_a", False) or \
+                _attr(node, "transpose_b", False):
+            raise MXNetError("onnx export: %s with transpose_a/b is not "
+                             "representable as MatMul" % node.op)
+        self.add_node("MatMul", [self.in_name(node, 0),
+                                 self.in_name(node, 1)],
+                      [node.name], node.name)
+
     def simple(self, node):
         op = MX2ONNX_OP[node.op]
         ins = [self.in_name(node, i) for i in range(len(node.inputs))]
@@ -289,6 +303,7 @@ class _Exporter:
         "Pooling": pooling, "Reshape": reshape, "softmax": softmax,
         "transpose": transpose, "Concat": concat, "Dropout": dropout,
         "clip": clip, "Embedding": embedding, "expand_dims": expand_dims,
+        "dot": dot, "batch_dot": dot,
         "_plus_scalar": scalar_op, "_minus_scalar": scalar_op,
         "_rminus_scalar": scalar_op, "_mul_scalar": scalar_op,
         "_div_scalar": scalar_op, "_rdiv_scalar": scalar_op,
@@ -395,7 +410,7 @@ ONNX2MX_OP = {
     "Floor": ("floor", {}), "Ceil": ("ceil", {}),
     "Add": ("broadcast_add", {}), "Sub": ("broadcast_sub", {}),
     "Mul": ("broadcast_mul", {}), "Div": ("broadcast_div", {}),
-    "Pow": ("broadcast_power", {}), "MatMul": ("dot", {}),
+    "Pow": ("broadcast_power", {}), "MatMul": ("matmul", {}),
     "Sum": ("add_n", {}), "Identity": ("identity", {}),
 }
 
@@ -418,6 +433,7 @@ class _Importer:
         self.env = {}          # tensor name -> Symbol
         self.used_params = set()
         self.unsupported_outputs = {}  # extra output name -> op_type
+        self._transposed = set()       # Gemm transB=0 weights, done once
 
     def sym_of(self, name):
         from ..symbol import symbol as S
@@ -483,8 +499,10 @@ class _Importer:
                     if w_name not in self.inits:
                         raise MXNetError("onnx import: Gemm transB=0 needs "
                                          "an initializer weight")
-                    self.inits[w_name] = \
-                        np.ascontiguousarray(self.inits[w_name].T)
+                    if w_name not in self._transposed:
+                        self.inits[w_name] = \
+                            np.ascontiguousarray(self.inits[w_name].T)
+                        self._transposed.add(w_name)
                 w = self.inits.get(w_name)
                 params = {"num_hidden": int(w.shape[0]) if w is not None
                           else 0, "no_bias": len(ins) < 3,

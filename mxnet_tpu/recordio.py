@@ -287,14 +287,9 @@ def pack_img(header, img, quality=95, img_fmt=".jpg"):
 
 def unpack_img(s, iscolor=1):
     """Decode a record into (IRHeader, HWC uint8 image array)."""
-    from PIL import Image
+    from .image.image import _decode_np
     header, img_bytes = unpack(s)
-    pil = Image.open(io.BytesIO(img_bytes))
-    if iscolor:
-        pil = pil.convert("RGB")
-    else:
-        pil = pil.convert("L")
-    arr = np.asarray(pil)
-    if arr.ndim == 2 and iscolor:
-        arr = np.stack([arr] * 3, axis=-1)
+    arr = _decode_np(bytes(img_bytes), iscolor)
+    if arr.shape[2] == 1 and iscolor:
+        arr = np.repeat(arr, 3, axis=2)
     return header, arr

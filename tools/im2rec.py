@@ -83,6 +83,12 @@ def _load_and_encode(path, args):
         left, top = (w - s) // 2, (h - s) // 2
         img = img.crop((left, top, left + s, top + s))
     import io as _io
+    if args.encoding == ".raw":
+        # raw decoded payload (HWC uint8): trades file size for decode
+        # throughput -- the fast path for codec-bound hosts (ImageIter
+        # detects it by payload length)
+        import numpy as _np
+        return _np.asarray(img, dtype=_np.uint8).tobytes()
     buf = _io.BytesIO()
     if args.encoding in (".jpg", ".jpeg"):
         img.save(buf, "JPEG", quality=args.quality)
@@ -122,7 +128,8 @@ def main(argv=None):
     p.add_argument("--resize", type=int, default=0)
     p.add_argument("--center-crop", action="store_true")
     p.add_argument("--quality", type=int, default=95)
-    p.add_argument("--encoding", default=".jpg")
+    p.add_argument("--encoding", default=".jpg",
+                   help=".jpg / .png / .raw (raw = pre-decoded uint8)")
     p.add_argument("--color", type=int, default=1, choices=[0, 1])
     args = p.parse_args(argv)
     if args.list:
