@@ -369,7 +369,7 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
     for k in range(n_batches):
         _d, l, _pad = it.next_np(out=host[k])
         host_labels[k] = l
-    it._rec.close()
+    it.close()
     shutil.rmtree(tmp, ignore_errors=True)
     staged = jax.device_put(host, dev)
     labels_dev = jax.device_put(host_labels, dev)

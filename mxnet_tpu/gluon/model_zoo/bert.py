@@ -25,9 +25,12 @@ class BERTModel(HybridBlock):
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
                  type_vocab_size=2, dropout=0.1, use_flash=False,
-                 dtype="float32", **kwargs):
+                 tp_mesh=None, tp_axis="tp", dtype="float32", **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._tp_mesh = tp_mesh
+        self._tp_axis = tp_axis
+        tp_mode = tp_mesh is not None
         with self.name_scope():
             self.word_embed = Embedding(vocab_size, units, dtype=dtype)
             self.token_type_embed = Embedding(type_vocab_size, units,
@@ -35,7 +38,7 @@ class BERTModel(HybridBlock):
             self.encoder = TransformerEncoder(
                 units, hidden_size, num_layers, num_heads,
                 max_length=max_length, dropout=dropout, use_flash=use_flash,
-                dtype=dtype)
+                tp_mode=tp_mode, dtype=dtype)
             # pooler over [CLS] for next-sentence prediction
             self.pooler = Dense(units, activation="tanh", flatten=False,
                                 in_units=units, dtype=dtype)
@@ -49,6 +52,27 @@ class BERTModel(HybridBlock):
             self.mlm_decoder = Dense(vocab_size, flatten=False,
                                      in_units=units, dtype=dtype)
             self.embed_drop = Dropout(dropout)
+
+    def shard_tp(self, mesh=None, axis=None):
+        """Megatron-shard the encoder over the ``tp`` mesh axis
+        (attention q/k/v column-parallel, out row-parallel, FFN
+        column+row): two psums per layer, inserted by XLA.  Embeddings,
+        pooler, and heads stay replicated.  Call after ``initialize``
+        (deferred params pick the sharding up at materialization)."""
+        mesh = mesh if mesh is not None else self._tp_mesh
+        axis = axis or self._tp_axis
+        if mesh is None:
+            raise ValueError("shard_tp needs a mesh (pass tp_mesh= at "
+                             "construction or mesh= here)")
+        from jax.sharding import PartitionSpec as P
+        from ...parallel.tensor_parallel import place_param
+        self.encoder.shard_tp(mesh, axis)
+        for block in (self.word_embed, self.token_type_embed, self.pooler,
+                      self.nsp_classifier, self.mlm_transform, self.mlm_ln,
+                      self.mlm_decoder):
+            for prm in block.collect_params().values():
+                place_param(prm, mesh, P())
+        return self
 
     def hybrid_forward(self, F, token_ids, token_types=None, valid_mask=None):
         x = self.word_embed(token_ids)
@@ -72,12 +96,15 @@ _SPECS = {
 
 
 def get_bert(name, vocab_size=30522, max_length=512, dropout=0.1,
-             use_flash=False, **kwargs):
+             use_flash=False, tp_mesh=None, **kwargs):
+    """``tp_mesh``: a Mesh with a ``tp`` axis builds the encoder in
+    tensor-parallel mode (separate column-parallel q/k/v); call
+    ``net.shard_tp()`` after ``initialize`` to place the params."""
     units, hidden, layers, heads = _SPECS[name]
     return BERTModel(vocab_size=vocab_size, units=units, hidden_size=hidden,
                      num_layers=layers, num_heads=heads,
                      max_length=max_length, dropout=dropout,
-                     use_flash=use_flash, **kwargs)
+                     use_flash=use_flash, tp_mesh=tp_mesh, **kwargs)
 
 
 def bert_base(**kwargs):

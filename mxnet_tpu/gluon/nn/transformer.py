@@ -29,7 +29,8 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 use_flash=False, causal=False, dtype="float32", **kwargs):
+                 use_flash=False, causal=False, tp_mode=False,
+                 dtype="float32", **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise ValueError("units %d not divisible by heads %d"
@@ -39,29 +40,110 @@ class MultiHeadAttention(HybridBlock):
         self._dropout = dropout
         self._use_flash = use_flash
         self._causal = causal
+        self._tp_mode = tp_mode
         with self.name_scope():
-            self.qkv_weight = self.params.get(
-                "qkv_weight", shape=(3 * units, 0), dtype=dtype,
-                allow_deferred_init=True)
+            if tp_mode:
+                # separate q/k/v projections: each weight's OUTPUT dim
+                # (u = heads*head_dim) column-shards cleanly over tp --
+                # a fused (3u, in) weight cannot carry a [q|k|v]-wise
+                # tp tiling as one NamedSharding, so slicing it sharded
+                # would reshard at every q/k/v split
+                for nm in ("query", "key", "value"):
+                    setattr(self, nm + "_weight", self.params.get(
+                        nm + "_weight", shape=(units, 0), dtype=dtype,
+                        allow_deferred_init=True))
+                    setattr(self, nm + "_bias", self.params.get(
+                        nm + "_bias", shape=(units,), dtype=dtype,
+                        init="zeros") if use_bias else None)
+                self.qkv_weight = None
+                self.qkv_bias = None
+            else:
+                self.qkv_weight = self.params.get(
+                    "qkv_weight", shape=(3 * units, 0), dtype=dtype,
+                    allow_deferred_init=True)
+                if use_bias:
+                    self.qkv_bias = self.params.get(
+                        "qkv_bias", shape=(3 * units,), dtype=dtype,
+                        init="zeros")
+                else:
+                    self.qkv_bias = None
             self.out_weight = self.params.get(
                 "out_weight", shape=(units, units), dtype=dtype)
             if use_bias:
-                self.qkv_bias = self.params.get(
-                    "qkv_bias", shape=(3 * units,), dtype=dtype,
-                    init="zeros")
                 self.out_bias = self.params.get(
                     "out_bias", shape=(units,), dtype=dtype, init="zeros")
             else:
-                self.qkv_bias = None
                 self.out_bias = None
 
     def infer_shape(self, x, *args):
-        self.qkv_weight.shape = (3 * self._units, x.shape[-1])
+        if self._tp_mode:
+            for nm in ("query", "key", "value"):
+                getattr(self, nm + "_weight").shape = \
+                    (self._units, x.shape[-1])
+        else:
+            self.qkv_weight.shape = (3 * self._units, x.shape[-1])
+
+    def shard_tp(self, mesh, axis="tp"):
+        """Megatron sharding: q/k/v column-parallel (output dims over
+        ``axis``), out row-parallel (input dim over ``axis``)."""
+        from jax.sharding import PartitionSpec as P
+        if not self._tp_mode:
+            raise ValueError("build the attention with tp_mode=True "
+                             "before sharding")
+        for nm in ("query", "key", "value"):
+            _tp_place(getattr(self, nm + "_weight"), mesh, P(axis, None))
+            bias = getattr(self, nm + "_bias")
+            if bias is not None:
+                _tp_place(bias, mesh, P(axis))
+        _tp_place(self.out_weight, mesh, P(None, axis))
+        if self.out_bias is not None:
+            _tp_place(self.out_bias, mesh, P())
+        return self
 
     def hybrid_forward(self, F, x, mask=None, qkv_weight=None, qkv_bias=None,
-                       out_weight=None, out_bias=None):
+                       out_weight=None, out_bias=None, query_weight=None,
+                       query_bias=None, key_weight=None, key_bias=None,
+                       value_weight=None, value_bias=None):
         b, seq, _ = x.shape
         h, hd = self._heads, self._units // self._heads
+        if self._tp_mode:
+            # tensor-parallel path: separate column-parallel q/k/v
+            # projections, and heads stay a standalone dim (b, h, seq,
+            # hd) so the head-dim sharding propagates through every
+            # matmul (merging b*h would hide the sharded factor behind
+            # the unsharded major dim and force an all-gather); one psum
+            # appears only at the row-parallel output FC
+            def proj4(w, bias):
+                t = F.FullyConnected(x, w, bias, num_hidden=self._units,
+                                     no_bias=bias is None, flatten=False)
+                return t.reshape((b, seq, h, hd)).transpose((0, 2, 1, 3))
+            q4 = proj4(query_weight, query_bias)
+            k4 = proj4(key_weight, key_bias)
+            v4 = proj4(value_weight, value_bias)
+            scores = F.matmul(q4, k4.transpose((0, 1, 3, 2))) \
+                * (1.0 / hd ** 0.5)
+            if mask is not None:
+                m = mask.reshape((b, 1, seq, seq))
+                scores = F.where(m.broadcast_to((b, h, seq, seq)), scores,
+                                 F.ones_like(scores) * -1e30)
+            elif self._causal:
+                # lower-triangular causal mask built from broadcast cmp
+                idx = F.arange(0, seq)
+                keep = idx.reshape((seq, 1)) >= idx.reshape((1, seq))
+                scores = F.where(
+                    keep.reshape((1, 1, seq, seq))
+                        .broadcast_to((b, h, seq, seq)),
+                    scores, F.ones_like(scores) * -1e30)
+            att = F.softmax(scores, axis=-1)
+            if self._dropout:
+                att = F.Dropout(att, p=self._dropout)
+            ctx4 = F.matmul(att, v4)
+            out = ctx4.transpose((0, 2, 1, 3)).reshape(
+                (b, seq, self._units))
+            return F.FullyConnected(out, out_weight, out_bias,
+                                    num_hidden=self._units,
+                                    no_bias=out_bias is None,
+                                    flatten=False)
         qkv = F.FullyConnected(x, qkv_weight, qkv_bias,
                                num_hidden=3 * self._units,
                                no_bias=qkv_bias is None, flatten=False)
@@ -94,6 +176,11 @@ class MultiHeadAttention(HybridBlock):
                                 no_bias=out_bias is None, flatten=False)
 
 
+def _tp_place(param, mesh, spec):
+    from ...parallel.tensor_parallel import place_param
+    place_param(param, mesh, spec)
+
+
 class PositionwiseFFN(HybridBlock):
     """Feed-forward block (BERT intermediate+output)."""
 
@@ -109,6 +196,16 @@ class PositionwiseFFN(HybridBlock):
                                dtype=dtype)
             self.drop = Dropout(dropout)
 
+    def shard_tp(self, mesh, axis="tp"):
+        from jax.sharding import PartitionSpec as P
+        _tp_place(self.ffn_1.weight, mesh, P(axis, None))
+        if self.ffn_1.bias is not None:
+            _tp_place(self.ffn_1.bias, mesh, P(axis))
+        _tp_place(self.ffn_2.weight, mesh, P(None, axis))
+        if self.ffn_2.bias is not None:
+            _tp_place(self.ffn_2.bias, mesh, P())
+        return self
+
     def hybrid_forward(self, F, x):
         return self.drop(self.ffn_2(self.ffn_1(x)))
 
@@ -117,19 +214,30 @@ class TransformerEncoderCell(HybridBlock):
     """Post-LN encoder cell (BERT style): LN(x + MHA(x)), LN(. + FFN(.))."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 use_flash=False, dtype="float32", **kwargs):
+                 use_flash=False, tp_mode=False, dtype="float32",
+                 **kwargs):
         super().__init__(**kwargs)
         from .basic_layers import Dropout, LayerNorm
         with self.name_scope():
             self.attention = MultiHeadAttention(units, num_heads,
                                                 dropout=dropout,
                                                 use_flash=use_flash,
+                                                tp_mode=tp_mode,
                                                 dtype=dtype)
             self.attn_drop = Dropout(dropout)
             self.ln_1 = LayerNorm(in_channels=units)
             self.ffn = PositionwiseFFN(units, hidden_size, dropout=dropout,
                                        dtype=dtype)
             self.ln_2 = LayerNorm(in_channels=units)
+
+    def shard_tp(self, mesh, axis="tp"):
+        from jax.sharding import PartitionSpec as P
+        self.attention.shard_tp(mesh, axis)
+        self.ffn.shard_tp(mesh, axis)
+        for p in (self.ln_1, self.ln_2):
+            for prm in p.collect_params().values():
+                _tp_place(prm, mesh, P())
+        return self
 
     def hybrid_forward(self, F, x, mask=None):
         att = self.attn_drop(self.attention(x, mask))
@@ -142,7 +250,7 @@ class TransformerEncoder(HybridBlock):
 
     def __init__(self, units, hidden_size, num_layers, num_heads,
                  max_length=512, dropout=0.0, use_flash=False,
-                 dtype="float32", **kwargs):
+                 tp_mode=False, dtype="float32", **kwargs):
         super().__init__(**kwargs)
         from .basic_layers import Dropout, LayerNorm
         self._max_length = max_length
@@ -157,9 +265,19 @@ class TransformerEncoder(HybridBlock):
                 cell = TransformerEncoderCell(units, hidden_size, num_heads,
                                               dropout=dropout,
                                               use_flash=use_flash,
+                                              tp_mode=tp_mode,
                                               dtype=dtype)
                 setattr(self, "cell%d" % i, cell)
                 self.cells.append(cell)
+
+    def shard_tp(self, mesh, axis="tp"):
+        from jax.sharding import PartitionSpec as P
+        for cell in self.cells:
+            cell.shard_tp(mesh, axis)
+        _tp_place(self.position_weight, mesh, P())
+        for prm in self.ln.collect_params().values():
+            _tp_place(prm, mesh, P())
+        return self
 
     def hybrid_forward(self, F, x, mask=None, position_weight=None):
         seq = x.shape[1]

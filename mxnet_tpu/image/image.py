@@ -234,9 +234,17 @@ class ImageIter:
             CreateAugmenter(data_shape)
         self.shuffle = shuffle
         self.dtype = np.dtype(dtype)
-        if aug_list is None and self.dtype != np.float32:
-            self.auglist = [a for a in self.auglist
-                            if not isinstance(a, CastAug)]
+        # an explicit CastAug in a user-supplied aug_list wins over the
+        # dtype parameter; for the default list the dtype parameter wins
+        # (and drops the redundant float32 CastAug)
+        if aug_list is None:
+            if self.dtype != np.float32:
+                self.auglist = [a for a in self.auglist
+                                if not isinstance(a, CastAug)]
+            self._final_dtype = self.dtype
+        else:
+            self._final_dtype = None if any(
+                isinstance(a, CastAug) for a in self.auglist)                 else self.dtype
         self._pool = None
         if preprocess_threads and preprocess_threads > 1:
             from concurrent.futures import ThreadPoolExecutor
@@ -266,6 +274,27 @@ class ImageIter:
             else np.arange(len(self._keys))
         self._cursor = 0
 
+    def close(self):
+        """Release the record reader and the decode thread pool."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        if self._rec is not None:
+            self._rec.close()
+            self._rec = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
     def _process_record(self, rec):
         """One raw record (bytes) -> (CHW float array, label).  Pure
         host-side work: safe to fan out over the thread pool."""
@@ -274,12 +303,22 @@ class ImageIter:
         label = header.label
         c, h, w = self.data_shape
         payload = bytes(payload)
-        if len(payload) == c * h * w and not _looks_compressed(payload):
+        if len(payload) == c * h * w:
             # raw (already-decoded) record: the im2rec --encoding .raw
             # fast path for hosts where codec throughput is the
-            # bottleneck.  A compressed image of exactly c*h*w bytes is
-            # disambiguated by its codec signature.
-            img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
+            # bottleneck.  Raw records carry no shape metadata --
+            # data_shape IS the contract.  A payload that length-matches
+            # but starts with a codec signature is decoded instead; if
+            # that decode fails (a raw image whose first pixels collide
+            # with a 2-byte magic) it falls back to the raw reshape
+            # rather than aborting the epoch.
+            if not _looks_compressed(payload):
+                img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
+                return self._augment(img), label
+            try:
+                img = _decode_np(payload, 1 if c == 3 else 0)
+            except Exception:
+                img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
             return self._augment(img), label
         img = _decode_np(payload, 1 if c == 3 else 0)
         return self._augment(img), label
@@ -296,9 +335,9 @@ class ImageIter:
         a = _as_np(img)
         if a.ndim == 3:
             a = a.transpose(2, 0, 1)
-        # the dtype parameter wins over any CastAug in the list (uint8
-        # batches transfer 4x smaller; the device casts on arrival)
-        return a.astype(self.dtype, copy=False)
+        if self._final_dtype is not None:
+            a = a.astype(self._final_dtype, copy=False)
+        return a
 
     def _read_one(self, key):
         if self._rec is not None:

@@ -433,7 +433,6 @@ class _Importer:
         self.env = {}          # tensor name -> Symbol
         self.used_params = set()
         self.unsupported_outputs = {}  # extra output name -> op_type
-        self._transposed = set()       # Gemm transB=0 weights, done once
 
     def sym_of(self, name):
         from ..symbol import symbol as S
@@ -499,10 +498,14 @@ class _Importer:
                     if w_name not in self.inits:
                         raise MXNetError("onnx import: Gemm transB=0 needs "
                                          "an initializer weight")
-                    if w_name not in self._transposed:
-                        self.inits[w_name] = \
+                    # keep the original untouched (it may feed other
+                    # consumers); this Gemm binds a transposed copy
+                    t_name = w_name + "_transposed"
+                    if t_name not in self.inits:
+                        self.inits[t_name] = \
                             np.ascontiguousarray(self.inits[w_name].T)
-                        self._transposed.add(w_name)
+                    w_name = t_name
+                    ins = [ins[0], t_name] + list(ins[2:])
                 w = self.inits.get(w_name)
                 params = {"num_hidden": int(w.shape[0]) if w is not None
                           else 0, "no_bias": len(ins) < 3,
