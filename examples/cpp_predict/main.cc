@@ -1,0 +1,65 @@
+// C++ edge inference example (reference: amalgamation/ +
+// example/image-classification/predict-cpp/): run a model exported with
+// mx.onnx.export_model from pure C++ -- no Python anywhere.
+//
+// Build (after building the runtime library):
+//   g++ -O2 -shared -fPIC -std=c++17 \
+//       ../../mxnet_tpu/_native/predict_native.cc -o libmxtpu_predict.so
+//   g++ -O2 -std=c++17 main.cc -o cpp_predict -L. -lmxtpu_predict \
+//       -Wl,-rpath,'$ORIGIN'
+// Run:
+//   ./cpp_predict model.onnx N C H W
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "../../mxnet_tpu/_native/mxnet_predict.h"
+
+int main(int argc, char** argv) {
+  if (argc < 6) {
+    fprintf(stderr, "usage: %s model.onnx N C H W\n", argv[0]);
+    return 2;
+  }
+  PredictorHandle h;
+  if (MXPredCreateFromFile(argv[1], &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXPredGetLastError());
+    return 1;
+  }
+  int64_t shape[4];
+  for (int i = 0; i < 4; ++i) shape[i] = atoll(argv[2 + i]);
+  int64_t numel = shape[0] * shape[1] * shape[2] * shape[3];
+  std::vector<float> input(static_cast<size_t>(numel), 0.f);
+  // deterministic pseudo-input so runs are comparable against Python
+  unsigned s = 12345;
+  for (auto& v : input) {
+    s = s * 1664525u + 1013904223u;
+    v = float(s >> 16) / 65536.0f - 0.5f;
+  }
+  if (MXPredSetInput(h, nullptr, input.data(), shape, 4) != 0 ||
+      MXPredForward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXPredGetLastError());
+    return 1;
+  }
+  int ndim;
+  if (MXPredGetOutputShape(h, 0, nullptr, &ndim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", MXPredGetLastError());
+    return 1;
+  }
+  std::vector<int64_t> oshape(static_cast<size_t>(ndim), 0);
+  MXPredGetOutputShape(h, 0, oshape.data(), &ndim);
+  int64_t on = 1;
+  printf("output shape: (");
+  for (int i = 0; i < ndim; ++i) {
+    on *= oshape[size_t(i)];
+    printf("%s%lld", i ? ", " : "", (long long)oshape[size_t(i)]);
+  }
+  printf(")\n");
+  std::vector<float> out(static_cast<size_t>(on), 0.f);
+  MXPredGetOutput(h, 0, out.data(), on);
+  printf("first outputs:");
+  for (int i = 0; i < (on < 8 ? int(on) : 8); ++i)
+    printf(" %.6f", out[size_t(i)]);
+  printf("\n");
+  MXPredFree(h);
+  return 0;
+}

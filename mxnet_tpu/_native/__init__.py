@@ -116,3 +116,64 @@ def load():
                       "using pure-Python recordio" % e)
         _LIB = None
     return _LIB
+
+
+# ----------------------------------------------------------------------
+# C predict runtime (predict_native.cc -- reference: c_predict_api.cc)
+# ----------------------------------------------------------------------
+
+_PRED_LIB = None
+_PRED_TRIED = False
+
+_PRED_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "predict_native.cc")
+
+
+def predict_so_path():
+    """Path the predict runtime builds to (for linking C consumers)."""
+    return os.path.join(_cache_dir(), "libmxtpu_predict.so")
+
+
+def load_predict():
+    """Build-on-demand loader for the C predict runtime; returns the
+    ctypes library or None (no toolchain / build failure)."""
+    global _PRED_LIB, _PRED_TRIED
+    if _PRED_TRIED:
+        return _PRED_LIB
+    _PRED_TRIED = True
+    if os.environ.get("MXNET_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        so = predict_so_path()
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(_PRED_SRC)):
+            _build(_PRED_SRC, so)
+        lib = ctypes.CDLL(so)
+        lib.MXPredGetLastError.restype = ctypes.c_char_p
+        lib.MXPredCreate.restype = ctypes.c_int
+        lib.MXPredCreate.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXPredCreateFromFile.restype = ctypes.c_int
+        lib.MXPredCreateFromFile.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXPredSetInput.restype = ctypes.c_int
+        lib.MXPredSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.MXPredForward.restype = ctypes.c_int
+        lib.MXPredForward.argtypes = [ctypes.c_void_p]
+        lib.MXPredGetOutputShape.restype = ctypes.c_int
+        lib.MXPredGetOutputShape.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXPredGetOutput.restype = ctypes.c_int
+        lib.MXPredGetOutput.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+        lib.MXPredFree.argtypes = [ctypes.c_void_p]
+        _PRED_LIB = lib
+    except Exception as e:  # degrade gracefully, like the recordio engine
+        warnings.warn("native predict runtime unavailable: %s" % e)
+        _PRED_LIB = None
+    return _PRED_LIB

@@ -221,3 +221,71 @@ class CompiledPredictor:
         return [NDArray(o) for o in outs]
 
     __call__ = forward
+
+
+class NativePredictor:
+    """Python handle to the C edge-predict runtime (reference:
+    ``c_predict_api.h`` workflow).  The runtime itself
+    (``_native/predict_native.cc``) is a dependency-free C++ interpreter
+    over exported ONNX artifacts with a flat C ABI -- usable from any
+    language with no Python; this class is the convenience binding for
+    tests and Python callers.
+    """
+
+    def __init__(self, onnx_path):
+        import ctypes
+        from ._native import load_predict
+        lib = load_predict()
+        if lib is None:
+            raise MXNetError("native predict runtime unavailable "
+                             "(no C++ toolchain?)")
+        self._lib = lib
+        self._h = ctypes.c_void_p()
+        rc = lib.MXPredCreateFromFile(str(onnx_path).encode(),
+                                      ctypes.byref(self._h))
+        if rc != 0:
+            raise MXNetError("MXPredCreate failed: %s"
+                             % lib.MXPredGetLastError().decode())
+
+    def forward(self, data, input_name=None):
+        import ctypes
+        import numpy as _np
+        lib = self._lib
+        a = _np.ascontiguousarray(_np.asarray(
+            data.asnumpy() if hasattr(data, "asnumpy") else data,
+            _np.float32))
+        shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+        rc = lib.MXPredSetInput(
+            self._h, input_name.encode() if input_name else None,
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape,
+            a.ndim)
+        if rc == 0:
+            rc = lib.MXPredForward(self._h)
+        if rc != 0:
+            raise MXNetError("MXPredForward failed: %s"
+                             % lib.MXPredGetLastError().decode())
+        # two-step query: rank first (shape=NULL), then the dims
+        ndim = ctypes.c_int()
+        lib.MXPredGetOutputShape(self._h, 0, None, ctypes.byref(ndim))
+        oshape = (ctypes.c_int64 * max(ndim.value, 1))()
+        lib.MXPredGetOutputShape(self._h, 0, oshape, ctypes.byref(ndim))
+        shp = tuple(oshape[i] for i in range(ndim.value))
+        out = _np.empty(shp, _np.float32)
+        rc = lib.MXPredGetOutput(
+            self._h, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.size)
+        if rc != 0:
+            raise MXNetError("MXPredGetOutput failed: %s"
+                             % lib.MXPredGetLastError().decode())
+        return out
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.MXPredFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

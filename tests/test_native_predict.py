@@ -1,0 +1,91 @@
+"""C-callable edge predict runtime (reference: ``c_predict_api.cc`` +
+``amalgamation/``): a compiled C program must run LeNet inference from
+an exported artifact with no Python in the loop."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.onnx import export_model
+from mxnet_tpu.predictor import NativePredictor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lenet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Conv2D(16, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(2, 2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    return net
+
+
+def _export(net, x, tmp_path, name):
+    want = net(mx.nd.array(x)).asnumpy()
+    sym_f, par_f = net.export(str(tmp_path / name))
+    onnx_file = str(tmp_path / (name + ".onnx"))
+    export_model(sym_f, par_f, in_shapes=[x.shape],
+                 onnx_file_path=onnx_file)
+    return onnx_file, want
+
+
+def test_native_predictor_matches_python(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 1, 28, 28).astype(np.float32)
+    onnx_file, want = _export(_lenet(), x, tmp_path, "lenet")
+    pred = NativePredictor(onnx_file)
+    got = pred.forward(x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    pred.close()
+
+
+def test_native_predictor_batchnorm_resnet_block(tmp_path):
+    rng = np.random.RandomState(1)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, use_bias=False),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.GlobalAvgPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+    onnx_file, want = _export(net, x, tmp_path, "bnblock")
+    pred = NativePredictor(onnx_file)
+    got = pred.forward(x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cpp_example_runs_without_python(tmp_path):
+    """Compile examples/cpp_predict/main.cc against the runtime and run
+    LeNet inference as a plain OS process."""
+    from mxnet_tpu._native import load_predict, predict_so_path
+    if load_predict() is None:
+        pytest.skip("no native toolchain")
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 1, 28, 28).astype(np.float32)
+    onnx_file, _want = _export(_lenet(), x, tmp_path, "lenet_c")
+
+    exe = str(tmp_path / "cpp_predict")
+    src = os.path.join(REPO, "examples", "cpp_predict", "main.cc")
+    so = predict_so_path()
+    proc = subprocess.run(
+        ["g++", "-O2", "-std=c++17", src, "-o", exe, so,
+         "-Wl,-rpath," + os.path.dirname(so)],
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    run = subprocess.run([exe, onnx_file, "1", "1", "28", "28"],
+                         capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "output shape: (1, 10)" in run.stdout, run.stdout
