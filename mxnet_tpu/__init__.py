@@ -73,6 +73,8 @@ from . import visualization as viz
 visualization = viz
 from . import onnx
 from . import contrib
+from . import env
+from . import preemption
 from . import horovod
 from . import name
 from . import attribute

@@ -1,0 +1,115 @@
+"""Typed registry of every ``MXNET_*`` environment variable the
+framework reads (reference: ``docs/static_site/src/pages/api/faq/
+env_var.md`` -- the reference documents its env vars on one page; here
+the page is generated from this registry, so it cannot go stale).
+
+Use ``mx.env.describe()`` for the rendered table, ``mx.env.get(name)``
+for a typed read, and ``mx.env.generate_doc(path)`` to (re)write
+``docs/env_vars.md``.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from .base import MXNetError
+
+__all__ = ["EnvVar", "REGISTRY", "get", "describe", "generate_doc"]
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    type: Callable
+    default: Any
+    doc: str
+
+    def read(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        try:
+            if self.type is bool:
+                # match the package's actual read convention: every
+                # boolean site tests != "0" (see e.g. ndarray.py's
+                # MXNET_TPU_EAGER_JIT), so only "0" disables
+                return raw != "0"
+            return self.type(raw)
+        except (TypeError, ValueError) as e:
+            raise MXNetError("env var %s=%r is not a valid %s"
+                             % (self.name, raw, self.type.__name__)) from e
+
+
+_VARS = [
+    EnvVar("MXNET_ENGINE_TYPE", str, "",
+           "Set to 'NaiveEngine' to make every op dispatch block until "
+           "the result is ready (reference semantics: synchronous debug "
+           "engine).  Default: async XLA dispatch."),
+    EnvVar("MXNET_TPU_EAGER_JIT", bool, True,
+           "Per-op persistent jit cache for eager NDArray ops.  '0' "
+           "falls back to uncached dispatch (debugging)."),
+    EnvVar("MXNET_TPU_COMPILATION_CACHE", bool, True,
+           "Persist compiled XLA programs to disk so later processes "
+           "start hot (the reference's analog is cuDNN autotune "
+           "caching).  '0' disables."),
+    EnvVar("MXNET_TPU_COMPILATION_CACHE_DIR", str,
+           "~/.cache/mxnet_tpu/xla",
+           "Directory for the persistent compilation cache."),
+    EnvVar("MXNET_TPU_NATIVE", bool, True,
+           "Build/load the native C++ components (recordio engine, "
+           "predict runtime).  '0' forces the pure-Python paths."),
+    EnvVar("MXNET_TPU_NATIVE_CACHE", str, "~/.cache/mxnet_tpu/native",
+           "Directory where on-demand native builds are cached."),
+    EnvVar("MXNET_OPTIMIZER_AGGREGATION_SIZE", int, 60,
+           "Max tensors fused into one multi-tensor optimizer update "
+           "(reference: same knob)."),
+    EnvVar("MXNET_PROFILER_AUTOSTART", bool, False,
+           "'1' starts the profiler at import (reference: same knob)."),
+    EnvVar("MXNET_TPU_COORDINATOR", str, "",
+           "host:port of the jax.distributed coordination service; set "
+           "by tools/launch.py for multi-process jobs."),
+    EnvVar("MXNET_TPU_NUM_PROCS", int, 1,
+           "World size of the multi-process job (set by the launcher)."),
+    EnvVar("MXNET_TPU_PROC_ID", int, 0,
+           "This process's rank (set by the launcher)."),
+    EnvVar("MXNET_CHECKPOINT_ON_SIGTERM", str, "",
+           "Checkpoint prefix used by mx.preemption.install() when no "
+           "prefix argument is given: SIGTERM drains pending work and "
+           "writes <prefix>-preempt.params/.states/.meta before exit."),
+]
+
+REGISTRY = {v.name: v for v in _VARS}
+
+
+def get(name):
+    """Typed read of a registered env var (raises for unknown names, so
+    typos fail loudly instead of silently defaulting)."""
+    if name not in REGISTRY:
+        raise MXNetError("unknown env var %r; registered: %s"
+                         % (name, ", ".join(sorted(REGISTRY))))
+    return REGISTRY[name].read()
+
+
+def describe():
+    """{name: (current_value, default, doc)} for every registered var."""
+    return {v.name: (v.read(), v.default, v.doc) for v in _VARS}
+
+
+def generate_doc(path=None):
+    """Render the env-var reference page (reference: env_var.md)."""
+    lines = ["# Environment variables",
+             "",
+             "Generated from `mxnet_tpu/env.py` -- the registry the "
+             "framework actually reads, so this page cannot go stale.",
+             "",
+             "| Variable | Type | Default | Description |",
+             "|---|---|---|---|"]
+    for v in _VARS:
+        lines.append("| `%s` | %s | `%r` | %s |"
+                     % (v.name, v.type.__name__, v.default, v.doc))
+    text = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text

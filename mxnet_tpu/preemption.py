@@ -1,0 +1,150 @@
+"""Preemption-aware checkpointing (SURVEY §5 failure detection).
+
+The reference's failure story is checkpoint/restart around engine
+crashes; the TPU-native analog is **preemption**: maintenance events
+deliver SIGTERM with a grace window.  ``install()`` arms a handler that,
+on signal, drains in-flight device work and writes the model parameters
+plus optimizer state, then lets the training loop exit cleanly via
+``handler.triggered``; ``resume()`` restores both on restart.
+
+Checkpoint layout: ``<prefix>-preempt.params`` (block parameters) and
+``<prefix>-preempt.states`` (Trainer/updater state), plus
+``<prefix>-preempt.meta`` (a tiny JSON with the step counter).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+
+from .base import MXNetError
+
+__all__ = ["PreemptionHandler", "install", "resume"]
+
+
+class PreemptionHandler:
+    """Arm signal-triggered checkpointing for a training loop.
+
+    Usage::
+
+        handler = mx.preemption.install(prefix, net, trainer)
+        for epoch in range(...):
+            for batch in data:
+                if handler.triggered:      # checkpoint already written
+                    return
+                step(...)
+    """
+
+    def __init__(self, prefix, block, trainer=None,
+                 signals=(signal.SIGTERM,), extra_state=None):
+        self.prefix = prefix
+        self.block = block
+        self.trainer = trainer
+        self.extra_state = extra_state or {}
+        self.triggered = False
+        self.saved = False
+        # RLock: the SIGTERM handler runs on the same thread and may
+        # interrupt an explicit save_now() call mid-save
+        self._lock = threading.RLock()
+        self._prev = {}
+        for sig in signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+
+    # -- paths ---------------------------------------------------------
+    @property
+    def params_path(self):
+        return self.prefix + "-preempt.params"
+
+    @property
+    def states_path(self):
+        return self.prefix + "-preempt.states"
+
+    @property
+    def meta_path(self):
+        return self.prefix + "-preempt.meta"
+
+    # -- save ----------------------------------------------------------
+    def save_now(self, step=None):
+        """Drain pending device work and write the checkpoint.  Safe to
+        call directly (e.g. at epoch boundaries) as well as from the
+        signal path.
+
+        Files are written to temp paths and renamed into place, with
+        the meta file LAST -- ``resume`` gates on the meta file, so a
+        SIGKILL at grace-window expiry can never leave a checkpoint
+        that loads truncated."""
+        from . import ndarray as nd
+        with self._lock:
+            if self.saved:
+                return
+            self.saved = True      # re-entrancy: signal during save
+            nd.waitall()           # drain the async queue first
+
+            def commit(path, write_fn):
+                tmp = "%s.%d.tmp" % (path, os.getpid())
+                write_fn(tmp)
+                os.replace(tmp, path)
+
+            commit(self.params_path, self.block.save_parameters)
+            if self.trainer is not None:
+                commit(self.states_path, self.trainer.save_states)
+            meta = {"step": step, "extra": self.extra_state}
+
+            def write_meta(tmp):
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+            commit(self.meta_path, write_meta)
+
+    def _on_signal(self, signum, frame):
+        self.triggered = True
+        try:
+            self.save_now()
+        finally:
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
+    def uninstall(self):
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev if prev is not None
+                          else signal.SIG_DFL)
+        self._prev = {}
+
+
+def install(prefix=None, block=None, trainer=None,
+            signals=(signal.SIGTERM,), extra_state=None):
+    """Arm SIGTERM-triggered checkpointing; returns the handler.
+
+    With ``prefix=None`` the prefix comes from the
+    ``MXNET_CHECKPOINT_ON_SIGTERM`` env var (operator-armed jobs)."""
+    if prefix is None:
+        from . import env as _env
+        prefix = _env.get("MXNET_CHECKPOINT_ON_SIGTERM")
+        if not prefix:
+            raise MXNetError("preemption.install: no prefix given and "
+                             "MXNET_CHECKPOINT_ON_SIGTERM is unset")
+    if block is None:
+        raise MXNetError("preemption.install needs the block to save")
+    return PreemptionHandler(prefix, block, trainer, signals=signals,
+                             extra_state=extra_state)
+
+
+def resume(prefix, block, trainer=None, ctx=None):
+    """Restore a preemption checkpoint if one exists.
+
+    Returns the saved meta dict (``{"step": ..., "extra": ...}``) or
+    None when no checkpoint is present (fresh start).
+    """
+    params = prefix + "-preempt.params"
+    states = prefix + "-preempt.states"
+    meta_path = prefix + "-preempt.meta"
+    # the meta file commits LAST in save_now: its presence proves the
+    # whole checkpoint landed (no truncated-params loads)
+    if not os.path.exists(meta_path) or not os.path.exists(params):
+        return None
+    block.load_parameters(params, ctx=ctx)
+    if trainer is not None and os.path.exists(states):
+        trainer.load_states(states)
+    with open(meta_path) as f:
+        return json.load(f)

@@ -83,3 +83,12 @@ class Features(dict):
 def feature_list():
     """Reference: ``libinfo_features``."""
     return list(Features().values())
+
+
+def env_vars():
+    """Every registered ``MXNET_*`` env var with its current (typed)
+    value, default, and doc -- backed by the ``mx.env`` registry, so
+    this listing and the generated doc page cannot drift from what the
+    code reads."""
+    from . import env as _env
+    return _env.describe()

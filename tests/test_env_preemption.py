@@ -1,0 +1,173 @@
+"""Typed env registry (reference: docs/faq/env_var.md convention) and
+preemption-aware checkpointing (SURVEY §5 failure detection)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- env registry ------------------------------------------------------
+
+def test_env_typed_reads(monkeypatch):
+    assert mx.env.get("MXNET_OPTIMIZER_AGGREGATION_SIZE") == 60
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "8")
+    assert mx.env.get("MXNET_OPTIMIZER_AGGREGATION_SIZE") == 8
+    monkeypatch.setenv("MXNET_TPU_EAGER_JIT", "0")
+    assert mx.env.get("MXNET_TPU_EAGER_JIT") is False
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "not-an-int")
+    with pytest.raises(MXNetError):
+        mx.env.get("MXNET_OPTIMIZER_AGGREGATION_SIZE")
+    with pytest.raises(MXNetError):
+        mx.env.get("MXNET_NO_SUCH_VAR")
+
+
+def test_env_registry_covers_code_usages():
+    """Every MXNET_* env var read anywhere in the package must be
+    registered (the registry is the doc page's source of truth)."""
+    import re
+    used = set()
+    pkg = os.path.join(REPO, "mxnet_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            src = open(os.path.join(root, f)).read()
+            for m in re.finditer(
+                    r"environ(?:\.get)?[(\[]\s*['\"](MXNET_[A-Z_0-9]+)",
+                    src):
+                used.add(m.group(1))
+    missing = used - set(mx.env.REGISTRY)
+    assert not missing, "unregistered env vars: %s" % sorted(missing)
+
+
+def test_env_doc_page_fresh():
+    generated = mx.env.generate_doc()
+    on_disk = open(os.path.join(REPO, "docs", "env_vars.md")).read()
+    assert generated == on_disk, \
+        "docs/env_vars.md is stale; regenerate with mx.env.generate_doc"
+
+
+def test_runtime_lists_env_vars():
+    listing = mx.runtime.env_vars()
+    assert "MXNET_TPU_EAGER_JIT" in listing
+    val, default, doc = listing["MXNET_TPU_EAGER_JIT"]
+    assert doc
+
+
+# -- preemption checkpointing -----------------------------------------
+
+def _net_and_trainer():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=None)
+    return net, tr
+
+
+def test_sigterm_checkpoints_and_resumes(tmp_path):
+    from mxnet_tpu import autograd
+    prefix = str(tmp_path / "job")
+    net, tr = _net_and_trainer()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 6).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, (8,)).astype(np.float32))
+
+    handler = mx.preemption.install(prefix, net, tr)
+    step = 0
+    for _ in range(20):
+        if handler.triggered:
+            break
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        tr.step(1)
+        step += 1
+        handler.extra_state["step"] = step
+        if step == 5:
+            os.kill(os.getpid(), signal.SIGTERM)
+    handler.uninstall()
+
+    assert handler.triggered and handler.saved
+    assert step == 5
+    assert os.path.exists(handler.params_path)
+    assert os.path.exists(handler.states_path)
+
+    # fresh process state: restore and verify params + momentum match
+    net2, tr2 = _net_and_trainer()
+    net2(x)  # materialize
+    meta = mx.preemption.resume(prefix, net2, tr2)
+    assert meta["extra"]["step"] == 5
+    for (_, p1), (_, p2) in zip(sorted(net.collect_params().items()),
+                                sorted(net2.collect_params().items())):
+        np.testing.assert_array_equal(p1.data().asnumpy(),
+                                      p2.data().asnumpy())
+    # trained nets continue identically after resume -> states match
+    for t, n in ((tr, net), (tr2, net2)):
+        with autograd.record():
+            l = loss_fn(n(x), y).mean()
+        l.backward()
+        t.step(1)
+    for (_, p1), (_, p2) in zip(sorted(net.collect_params().items()),
+                                sorted(net2.collect_params().items())):
+        np.testing.assert_allclose(p1.data().asnumpy(),
+                                   p2.data().asnumpy(), rtol=1e-6)
+
+
+def test_resume_without_checkpoint_returns_none(tmp_path):
+    net, tr = _net_and_trainer()
+    assert mx.preemption.resume(str(tmp_path / "none"), net, tr) is None
+
+
+def test_external_sigterm_subprocess(tmp_path):
+    """Realistic shape: the OS delivers SIGTERM to a training process;
+    it must exit cleanly having written the checkpoint."""
+    prefix = str(tmp_path / "ext")
+    code = """
+import os, signal, sys, time
+sys.path.insert(0, %r)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(8), gluon.nn.Dense(4))
+net.initialize(ctx=mx.cpu()); net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+handler = mx.preemption.install(%r, net, tr)
+x = mx.nd.array(np.random.randn(4, 6).astype("float32"))
+y = mx.nd.array(np.zeros(4, "float32"))
+print("READY", flush=True)
+i = 0
+while not handler.triggered:
+    with autograd.record():
+        l = loss_fn(net(x), y).mean()
+    l.backward(); tr.step(1); i += 1
+print("CHECKPOINTED after", i, "steps", flush=True)
+""" % (REPO, prefix)
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "READY"
+    import time
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    assert "CHECKPOINTED" in out, out
+    assert os.path.exists(prefix + "-preempt.params")
+    meta = json.load(open(prefix + "-preempt.meta"))
+    assert "step" in meta
