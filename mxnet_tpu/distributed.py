@@ -103,6 +103,37 @@ def _client():
     return distributed.global_state.client
 
 
+_KV_FALLBACK_WARNED = [False]
+
+
+def _warn_kv_fallback():
+    """The coordination-service KV transport funnels every rank's full
+    tensor through the coordinator: O(N*P) bytes through one process.
+    It exists for test environments whose backend world is
+    single-process (jax.process_count() == 1 despite a multi-rank
+    launcher).  A MULTI-process backend reaching this path means the
+    world sizes disagree -- a misconfigured pod where backend
+    collectives should have run -- so that case errors instead of
+    silently funneling a pod's gradients through one host."""
+    import warnings
+    import jax
+    if jax.process_count() > 1:
+        from .base import MXNetError
+        raise MXNetError(
+            "host collective fallback (coordination-service KV) reached "
+            "with a multi-process backend (jax.process_count()=%d != "
+            "launcher world): the distributed init is misconfigured; "
+            "backend collectives must run on a pod (check "
+            "tools/launch.py / JAX distributed init)"
+            % jax.process_count())
+    if not _KV_FALLBACK_WARNED[0]:
+        _KV_FALLBACK_WARNED[0] = True
+        warnings.warn(
+            "using the coordination-service KV fallback for host "
+            "collectives (backend is not multi-process); fine for "
+            "tests, never the real-pod path")
+
+
 def host_allreduce(arr, average=False, timeout_ms=60000):
     """Sum (or mean) a host array across every process.  Uses backend
     collectives when the backend is multi-process; otherwise the
@@ -118,6 +149,7 @@ def host_allreduce(arr, average=False, timeout_ms=60000):
         from jax.experimental import multihost_utils
         g = multihost_utils.process_allgather(jnp.asarray(arr))
         return jnp.mean(g, axis=0) if average else jnp.sum(g, axis=0)
+    _warn_kv_fallback()
     client = _client()
     x = np.asarray(arr)
     _seq[0] += 1
@@ -143,6 +175,7 @@ def host_broadcast(arr, root=0, timeout_ms=60000):
     nproc, rank = world()
     if nproc == 1:
         return jnp.asarray(arr)
+    _warn_kv_fallback()
     client = _client()
     x = np.asarray(arr)
     _seq[0] += 1

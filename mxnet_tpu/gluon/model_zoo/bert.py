@@ -24,7 +24,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 type_vocab_size=2, dropout=0.1, use_flash=False,
+                 type_vocab_size=2, dropout=0.1, use_flash=None,
                  tp_mesh=None, tp_axis="tp", dtype="float32", **kwargs):
         super().__init__(**kwargs)
         self._units = units
@@ -96,7 +96,7 @@ _SPECS = {
 
 
 def get_bert(name, vocab_size=30522, max_length=512, dropout=0.1,
-             use_flash=False, tp_mesh=None, **kwargs):
+             use_flash=None, tp_mesh=None, **kwargs):
     """``tp_mesh``: a Mesh with a ``tp`` axis builds the encoder in
     tensor-parallel mode (separate column-parallel q/k/v); call
     ``net.shard_tp()`` after ``initialize`` to place the params."""

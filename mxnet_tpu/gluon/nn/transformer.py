@@ -23,13 +23,15 @@ class MultiHeadAttention(HybridBlock):
     """Self/cross multi-head attention (reference kernels:
     ``interleaved_matmul_selfatt_qk/valatt``).
 
-    ``use_flash=True`` routes the no-mask path through the Pallas flash
-    kernel on TPU; with a mask (or ``use_flash=False``) the XLA path
-    materializes masked scores (still fused by the compiler).
+    ``use_flash``: True = Pallas flash kernels (fwd + blockwise bwd;
+    masked variant included), False = XLA path, None (default) = auto,
+    Pallas on TPU backends when the sequence tiles evenly.  The masked
+    XLA fallback (and the dropout>0 path) materializes masked scores
+    per fusion tile.
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 use_flash=False, causal=False, tp_mode=False,
+                 use_flash=None, causal=False, tp_mode=False,
                  dtype="float32", **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
@@ -156,9 +158,18 @@ class MultiHeadAttention(HybridBlock):
                                   end=2 * self._units))
         v = heads_of(F.slice_axis(qkv, axis=2, begin=2 * self._units,
                                   end=3 * self._units))
+        from ... import autograd as _ag
         if mask is None:
             ctx_out = F.flash_attention(q, k, v, causal=self._causal,
                                         use_pallas=self._use_flash)
+        elif not self._dropout or not _ag.is_training():
+            # dropout only matters while training; inference with the
+            # standard padding mask takes the flash path
+            # masked flash path: the (b, seq, seq) padding mask rides
+            # into the kernel; no (seq, seq) scores in HBM
+            ctx_out = F.flash_attention_masked(
+                q, k, v, mask.reshape((b, seq, seq)), heads=h,
+                use_pallas=self._use_flash)
         else:
             scores = F.batch_dot(q, k, transpose_b=True) * (1.0 / hd ** 0.5)
             # mask: (b, seq_q, seq_k) with 1 = attend; broadcast over heads
@@ -214,7 +225,7 @@ class TransformerEncoderCell(HybridBlock):
     """Post-LN encoder cell (BERT style): LN(x + MHA(x)), LN(. + FFN(.))."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 use_flash=False, tp_mode=False, dtype="float32",
+                 use_flash=None, tp_mode=False, dtype="float32",
                  **kwargs):
         super().__init__(**kwargs)
         from .basic_layers import Dropout, LayerNorm
@@ -249,7 +260,7 @@ class TransformerEncoder(HybridBlock):
     """Stack of encoder cells with learned positional embedding."""
 
     def __init__(self, units, hidden_size, num_layers, num_heads,
-                 max_length=512, dropout=0.0, use_flash=False,
+                 max_length=512, dropout=0.0, use_flash=None,
                  tp_mode=False, dtype="float32", **kwargs):
         super().__init__(**kwargs)
         from .basic_layers import Dropout, LayerNorm

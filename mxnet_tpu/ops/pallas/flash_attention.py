@@ -1,16 +1,19 @@
-"""Flash attention forward kernel (Pallas/TPU).
+"""Flash attention kernels (Pallas/TPU): forward AND backward, with
+optional padding-mask support.
 
 Replaces the reference's fused BERT attention kernels
 (``src/operator/contrib/transformer.cc :: interleaved_matmul_selfatt_*``,
 which materialize the (seq, seq) score matrix in HBM) with the blockwise
 online-softmax algorithm: scores never leave VMEM, so HBM traffic is
-O(seq*d) instead of O(seq^2) and long sequences stop being
-bandwidth-bound.
+O(seq*d) instead of O(seq^2) in BOTH directions -- the backward replays
+score blocks from the forward-saved logsumexp instead of materializing
+the fp32 score matrix, which is what makes long-context training
+memory-feasible.
 
-Layout: (batch*heads, seq, head_dim) -- grid over (bh, q_block); each
-program streams KV blocks through VMEM with a running (max, sum, acc)
-carry.  fp32 accumulation regardless of input dtype (MXU-native bf16 in,
-fp32 out).
+Layout: (batch*heads, seq, head_dim); optional mask (batch, seq, seq)
+with 1 = attend (``heads`` static so kernels can map bh -> batch).
+fp32 accumulation regardless of input dtype (MXU-native bf16 in, fp32
+accumulate).
 """
 from __future__ import annotations
 
@@ -21,9 +24,24 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+try:  # pallas import kept lazy-safe: CPU-only builds fall back to XLA
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                seq_len):
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+def _fwd_kernel(*refs, block_k, causal, scale, seq_len, has_mask):
+    if has_mask:
+        q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        mask_ref = None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)           # (block_q, d)
     block_q = q.shape[0]
@@ -47,6 +65,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
             cols = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if mask_ref is not None:
+            mblk = mask_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(mblk > 0, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -60,22 +81,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp per row, replicated over 8 sublanes: Mosaic requires the
+    # last two block dims be (8, 128)-tileable, so a (1, block_q) row
+    # is stored as (8, block_q) and row 0 read back
+    row = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(row[None, :], (8, row.shape[0]))
 
 
-try:  # pallas import kept lazy-safe: CPU-only builds fall back to XLA
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
+def _qmask_spec(block_q, seq, heads):
+    # mask is (batch, seq, seq); program b indexes batch = bh // heads
+    return pl.BlockSpec((1, block_q, seq),
+                        lambda b, i: (b // heads, i, 0))
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
-                                             "block_k", "interpret"))
-def flash_attention_fwd_pallas(q, k, v, causal=False, scale=1.0,
-                               block_q=256, block_k=256, interpret=False):
-    """q,k,v: (bh, seq, d) -> (bh, seq, d)."""
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "heads", "interpret"))
+def flash_attention_fwd_pallas(q, k, v, mask=None, causal=False, scale=1.0,
+                               block_q=256, block_k=256, heads=1,
+                               interpret=False):
+    """q,k,v: (bh, seq, d) [+ mask (b, seq, seq), 1 = attend]
+    -> (out (bh, seq, d), lse (bh, seq))."""
     bh, seq, d = q.shape
     block_q = min(block_q, seq)
     block_k = min(block_k, seq)
@@ -83,16 +110,201 @@ def flash_attention_fwd_pallas(q, k, v, causal=False, scale=1.0,
         "flash attention needs seq divisible by block sizes"
     grid = (bh, seq // block_q)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_len=seq)
-    return pl.pallas_call(
+                               scale=scale, seq_len=seq,
+                               has_mask=mask is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if mask is not None:
+        in_specs.append(_qmask_spec(block_q, seq, heads))
+        args.append(mask)
+    out, lse8 = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, 8, seq), jnp.float32)],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, 8, block_q),
+                                lambda b, i: (b, 0, i))],
+        interpret=interpret,
+    )(*args)
+    return out, lse8[:, 0, :]
+
+
+# ----------------------------------------------------------------------
+# backward: dk/dv kernel (grid over kv blocks) + dq kernel (q blocks)
+# ----------------------------------------------------------------------
+
+def _bwd_dkv_kernel(*refs, block_q, causal, scale, seq_len, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+        mask_ref = None
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)            # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    d = k.shape[1]
+
+    start_q = 0
+    if causal:
+        # q rows strictly above the block's first kv column never attend
+        start_q = (ki * block_k) // block_q
+
+    def body(j, carry):
+        dk, dv = carry
+        qj = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        doj = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(j * block_q, block_q)]
+        s = jax.lax.dot_general(
+            qj, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if mask_ref is not None:
+            mblk = mask_ref[0, pl.ds(j * block_q, block_q), :]
+            s = jnp.where(mblk > 0, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])            # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p, doj, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bk, d)
+        dp = jax.lax.dot_general(
+            doj, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, qj, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # (bk, d)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, pl.cdiv(seq_len, block_q), body,
+                               (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(*refs, block_k, causal, scale, seq_len, has_mask):
+    if has_mask:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+         dq_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref) = refs
+        mask_ref = None
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)            # (block_q, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q = q.shape[0]
+    d = q.shape[1]
+
+    num_kv = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kv = pl.cdiv((qi + 1) * block_q, block_k)
+
+    def body(j, dq):
+        kj = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vj = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if mask_ref is not None:
+            mblk = mask_ref[0, :, pl.ds(j * block_k, block_k)]
+            s = jnp.where(mblk > 0, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_kv, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "heads", "interpret"))
+def flash_attention_bwd_pallas(q, k, v, lse, dout, delta, mask=None,
+                               causal=False, scale=1.0, block_q=256,
+                               block_k=256, heads=1, interpret=False):
+    """Blockwise flash backward -> (dq, dk, dv), O(seq*d) memory.
+
+    ``delta`` is rowsum(dout * out) -- the softmax-jacobian correction,
+    computed outside so the saved residuals are just (q, k, v, out, lse).
+    """
+    bh, seq, d = q.shape
+    block_q = min(block_q, seq)
+    block_k = min(block_k, seq)
+
+    # (bh, seq) row vectors carried in the (bh, 8, seq) sublane-
+    # replicated layout the Mosaic tiling rules want (see fwd)
+    lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, seq))
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, seq))
+
+    seq_spec = pl.BlockSpec((1, seq, d), lambda b, i: (b, 0, 0))
+    vec_spec = pl.BlockSpec((1, 8, seq), lambda b, i: (b, 0, 0))
+
+    args = [q, k, v, dout, lse8, delta8]
+    dkv_specs = [seq_spec,
+                 pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                 pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                 seq_spec, vec_spec, vec_spec]
+    dq_specs = [pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                seq_spec, seq_spec,
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+                pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i))]
+    if mask is not None:
+        # dkv iterates q rows with kv fixed: full rows x block_k columns
+        dkv_specs.append(pl.BlockSpec(
+            (1, seq, block_k), lambda b, i: (b // heads, 0, i)))
+        dq_specs.append(_qmask_spec(block_q, seq, heads))
+        args.append(mask)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale, seq_len=seq,
+                          has_mask=mask is not None),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        grid=(bh, seq // block_k),
+        in_specs=dkv_specs,
+        out_specs=[pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0))],
+        interpret=interpret,
+    )(*args)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale, seq_len=seq,
+                          has_mask=mask is not None),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(bh, seq // block_q),
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
+    return dq, dk, dv

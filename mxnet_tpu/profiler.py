@@ -231,7 +231,7 @@ def marker(name, scope="process"):
 
 # reference env: start profiling at import when requested; the trace
 # only hits disk at stop, so flush at interpreter exit
-if os.environ.get("MXNET_PROFILER_AUTOSTART") == "1":
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") != "0":
     import atexit
     set_state("run")
     atexit.register(stop)

@@ -113,12 +113,12 @@ def test_flash_attention_pallas_interpret_matches_xla():
     k = jax.device_put(jnp.asarray(rng.randn(2, 16, 8).astype(np.float32)), cpu)
     v = jax.device_put(jnp.asarray(rng.randn(2, 16, 8).astype(np.float32)), cpu)
     for causal in (False, True):
-        out = flash_attention_fwd_pallas(q, k, v, causal=causal, scale=0.3,
-                                         block_q=8, block_k=8,
-                                         interpret=True)
+        out, _lse = flash_attention_fwd_pallas(
+            q, k, v, causal=causal, scale=0.3, block_q=8, block_k=8,
+            interpret=True)
         ref = _attention_reference(q, k, v, causal, 0.3)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_multihead_attention_layer_masked_vs_unmasked():
