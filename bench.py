@@ -28,20 +28,29 @@ def _ctx():
     return mx.tpu() if mx.num_tpus() else mx.cpu()
 
 
-def _cpu_subprocess_value(expr, timeout=600):
-    """Evaluate ``expr`` (a bench.* call) in a fresh CPU-only interpreter
-    and return its printed float -- keeps the CPU backend out of this
-    process while measuring local-dispatch numbers."""
+def _subprocess_value(expr, timeout=600, force_cpu=False):
+    """Evaluate ``expr`` (a bench.* call) in a fresh interpreter and
+    return its printed float.  ``force_cpu`` keeps the CPU backend out
+    of this process (local-dispatch measurements); without it the child
+    sees the same accelerator but with a FRESH tunnel -- host->device
+    transfers collapse to ~10 MB/s in any process whose TPU has already
+    run compute (docs/perf_resnet50.md), so transfer-sensitive configs
+    must not share this process."""
     import subprocess
     import sys
     code = ("import sys; sys.path.insert(0, %r); import bench; "
             "print(%s)" % (_os.path.dirname(_os.path.abspath(__file__)),
                            expr))
     env = dict(_os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run([sys.executable, "-c", code], env=env,
                          capture_output=True, text=True, timeout=timeout)
     return float(out.stdout.strip().splitlines()[-1])
+
+
+def _cpu_subprocess_value(expr, timeout=600):
+    return _subprocess_value(expr, timeout=timeout, force_cpu=True)
 
 
 def _bench_train(net, loss_fn, data_shape, label_shape, n_classes,
@@ -529,7 +538,11 @@ def main():
 
     if on_tpu:
         try:
-            e2e = bench_resnet50_e2e(rn_bs * 2, dtype="bfloat16")
+            # fresh subprocess: the dataset staging transfer must happen
+            # before any compute touches this process's tunnel
+            e2e = _subprocess_value(
+                "bench.bench_resnet50_e2e(%d, dtype='bfloat16')"
+                % (rn_bs * 2), timeout=1200)
             results["resnet50_e2e"] = e2e
             print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
                               "value": round(e2e, 1), "unit": "img/s",

@@ -35,9 +35,10 @@ class Executor:
     """Bound executor (reference: ``Executor.forward/backward/outputs``)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None):
+                 grad_req="write", aux_states=None, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        self._group2ctx = dict(group2ctx) if group2ctx else None
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
@@ -80,6 +81,53 @@ class Executor:
         vals.update({k: v._data for k, v in self.aux_dict.items()})
         return vals
 
+    # -- ctx_group model parallelism (reference: AttrScope(ctx_group=)
+    # + bind(group2ctx=), example/model-parallel-lstm) ----------------
+    def _forward_grouped(self):
+        """Per-node eager execution with explicit inter-group device
+        transfers -- the reference's PlaceDevice semantics (each op runs
+        on its group's device, copies inserted at group boundaries).
+        The SPMD-native way to split models is mxnet_tpu.parallel's
+        TP/PP over a Mesh; this path is the compatibility shim for
+        ctx_group graphs."""
+        import jax
+        from .symbol.symbol import _eval_node_value
+
+        def dev_of(node):
+            group = node.attrs.get("ctx_group") if node.attrs else None
+            ctx = self._group2ctx.get(group) if group else None
+            ctx = ctx or self._ctx
+            return ctx.jax_device() if ctx is not None else None
+
+        vals = {}
+        feed = self._all_vals()
+        for node in self._symbol._topo():
+            if node.op is None:
+                v = feed.get(node.name)
+                if v is None:
+                    raise MXNetError("unbound variable %r" % node.name)
+                dev = dev_of(node)
+                if dev is not None and dev not in v.devices():
+                    v = jax.device_put(v, dev)
+                vals[(id(node), 0)] = v
+                continue
+            dev = dev_of(node)
+            if dev is not None:
+                for src, oi in node.inputs:
+                    cur = vals[(id(src), oi)]
+                    if dev not in cur.devices():
+                        # group boundary: explicit transfer
+                        vals[(id(src), oi)] = jax.device_put(cur, dev)
+            out = _eval_node_value(node, vals)
+            if isinstance(out, tuple):
+                for i, o in enumerate(out):
+                    vals[(id(node), i)] = o
+            else:
+                vals[(id(node), 0)] = out
+        self.outputs = [NDArray(vals[(id(n), i)])
+                        for n, i in self._symbol._outputs]
+        return self.outputs
+
     def forward(self, is_train=False, **kwargs):
         """Run the graph (reference: ``GraphExecutor::RunOps``)."""
         for k, v in kwargs.items():
@@ -87,6 +135,14 @@ class Executor:
                 raise MXNetError("unknown input %r" % k)
             self.arg_dict[k]._data = v._data if isinstance(v, NDArray) \
                 else v
+        if self._group2ctx:
+            if is_train:
+                raise MXNetError(
+                    "group2ctx training is not supported by the compat "
+                    "shim (per-op device placement, forward only); use "
+                    "mxnet_tpu.parallel tensor/pipeline parallelism for "
+                    "SPMD model-parallel training")
+            return self._forward_grouped()
         vals = self._all_vals()
         if is_train:
             grad_names = [n for n in self.arg_names

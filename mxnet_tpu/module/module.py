@@ -100,7 +100,7 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False,
-             shared_module=None, grad_req="write"):
+             shared_module=None, grad_req="write", group2ctx=None):
         """Allocate the executor for the given input shapes (reference:
         ``Module.bind``).  Weight shapes come from graph shape inference
         (`Symbol.infer_shape`)."""
@@ -137,7 +137,8 @@ class Module(BaseModule):
                       for n, s in zip(self._aux_names, aux_shapes)}
         from ..executor import Executor
         self._exec = Executor(self._symbol, self._context, args, args_grad,
-                              req, aux_states=aux_states)
+                              req, aux_states=aux_states,
+                              group2ctx=group2ctx)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             arg_p, aux_p = shared_module.get_params()

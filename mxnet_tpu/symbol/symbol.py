@@ -189,10 +189,10 @@ class Symbol:
         return outs
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, **kwargs):
+             aux_states=None, group2ctx=None, **kwargs):
         from ..executor import Executor
         return Executor(self, ctx, args, args_grad, grad_req,
-                        aux_states=aux_states)
+                        aux_states=aux_states, group2ctx=group2ctx)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         """Allocate all arguments and bind (reference: ``simple_bind``).

@@ -1,0 +1,81 @@
+"""ctx_group model-parallel compat shim (reference:
+``AttrScope(ctx_group=...)`` + ``bind(group2ctx=...)``,
+``example/model-parallel-lstm``): per-node device placement with
+explicit transfers at group boundaries; SPMD TP/PP is the native
+training path."""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def _two_stage():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = sym.var("data")
+        h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        out = sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return out
+
+
+def _args():
+    return {"data": mx.nd.zeros((2, 8)),
+            "fc1_weight": mx.nd.ones((16, 8)) * 0.1,
+            "fc1_bias": mx.nd.zeros((16,)),
+            "fc2_weight": mx.nd.ones((4, 16)) * 0.1,
+            "fc2_bias": mx.nd.zeros((4,))}
+
+
+def test_group2ctx_places_and_computes():
+    out = _two_stage()
+    g2c = {"stage1": mx.Context("cpu", 1), "stage2": mx.Context("cpu", 3)}
+    exe = out.bind(ctx=mx.cpu(0), args=_args(), grad_req="null",
+                   group2ctx=g2c)
+    outs = exe.forward(data=mx.nd.ones((2, 8)))
+    x = np.ones((2, 8), np.float32)
+    h = np.maximum(x @ (np.ones((8, 16), np.float32) * 0.1), 0)
+    want = h @ (np.ones((16, 4), np.float32) * 0.1)
+    np.testing.assert_allclose(outs[0].asnumpy(), want, rtol=1e-5)
+    # the final node ran on stage2's device
+    assert jax.devices("cpu")[3] in outs[0]._data.devices()
+
+
+def test_group2ctx_matches_ungrouped():
+    out = _two_stage()
+    rng = np.random.RandomState(0)
+    args = {k: mx.nd.array(rng.randn(*v.shape).astype(np.float32))
+            for k, v in _args().items()}
+    plain = out.bind(ctx=mx.cpu(), args=dict(args), grad_req="null")
+    want = plain.forward()[0].asnumpy()
+    g2c = {"stage1": mx.Context("cpu", 2), "stage2": mx.Context("cpu", 5)}
+    exe = out.bind(ctx=mx.cpu(0), args=dict(args), grad_req="null",
+                   group2ctx=g2c)
+    got = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_group2ctx_training_redirects_to_spmd():
+    out = _two_stage()
+    g2c = {"stage1": mx.Context("cpu", 1), "stage2": mx.Context("cpu", 3)}
+    exe = out.bind(ctx=mx.cpu(0), args=_args(), grad_req="null",
+                   group2ctx=g2c)
+    with pytest.raises(MXNetError, match="parallel"):
+        exe.forward(is_train=True)
+
+
+def test_unknown_group_falls_back_to_default_ctx():
+    with mx.AttrScope(ctx_group="nowhere"):
+        data = sym.var("data")
+        out = sym.FullyConnected(data, num_hidden=4, name="fc")
+    exe = out.bind(ctx=mx.cpu(0),
+                   args={"data": mx.nd.ones((2, 8)),
+                         "fc_weight": mx.nd.ones((4, 8)),
+                         "fc_bias": mx.nd.zeros((4,))},
+                   grad_req="null", group2ctx={"stage1": mx.cpu(1)})
+    outs = exe.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full((2, 4), 8.0))
