@@ -54,8 +54,14 @@ _VARS = [
            "start hot (the reference's analog is cuDNN autotune "
            "caching).  '0' disables."),
     EnvVar("MXNET_TPU_COMPILATION_CACHE_DIR", str,
-           "~/.cache/mxnet_tpu/xla",
-           "Directory for the persistent compilation cache."),
+           "~/.cache/mxnet_tpu/xla/<fingerprint>",
+           "Directory for the persistent compilation cache.  When unset, "
+           "a per-build subdirectory of ~/.cache/mxnet_tpu/xla keyed on "
+           "the jax/jaxlib/libtpu versions and host CPU model+flags is "
+           "used, so a home directory shared across machines or compiler "
+           "upgrades never serves stale AOT executables (SIGILL / "
+           "libtpu-version-mismatch hazard).  Setting the var explicitly "
+           "bypasses the fingerprinting."),
     EnvVar("MXNET_TPU_NATIVE", bool, True,
            "Build/load the native C++ components (recordio engine, "
            "predict runtime).  '0' forces the pure-Python paths."),
