@@ -234,8 +234,9 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
 
 
 def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
-                    dtype="float32", use_flash=True, iters=20):
-    """BERT-base masked-LM pretraining step, tokens/s (config 3)."""
+                    dtype="float32", use_flash=None, iters=20):
+    """BERT-base masked-LM pretraining step (config 3).
+    Returns (tokens/s, mfu_or_None)."""
     import contextlib
     import mxnet_tpu as mx
     from mxnet_tpu import amp, gluon
@@ -276,7 +277,12 @@ def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
             last = step(ids, labels)
         float(last.asscalar())
         dt = time.perf_counter() - t0
-    return batch_size * seq_len * iters / dt
+        ca = step.cost_analysis()
+    mfu = None
+    peak = _peak_flops()
+    if ca and ca.get("flops") and peak:
+        mfu = round(ca["flops"] * iters / (dt * peak), 4)
+    return batch_size * seq_len * iters / dt, mfu
 
 
 def _build_rec(path, n, fmt="jpg", hw=256, crop=224, seed=0):
@@ -417,10 +423,11 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
 
 
 def _emit_with_retry(metric, fn, attempts=2, unit="tokens/s",
-                     extra=None):
+                     extra=None, extra_fn=None):
     """Run fn() with retries (the tunneled compile service can drop a
     connection mid-build); emit one JSON line either way, keyed by the
-    SAME metric name on success and failure."""
+    SAME metric name on success and failure.  ``extra_fn`` is called
+    after a successful run for fields computed during it."""
     for attempt in range(attempts):
         try:
             val = fn()
@@ -428,6 +435,8 @@ def _emit_with_retry(metric, fn, attempts=2, unit="tokens/s",
                    "vs_baseline": None}
             if extra:
                 rec.update(extra)
+            if extra_fn is not None:
+                rec.update(extra_fn())
             print(json.dumps(rec))
             return val
         except Exception as e:
@@ -551,27 +560,39 @@ def main():
             print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
                               "error": str(e)[:200]}))
 
-    # bs=128 is the single-chip throughput knee (measured: 38k tok/s at
-    # bs16 -> 116k at bs128, flat beyond)
-    bert_bs = 128 if on_tpu else 2
-    bert_seq = 128 if on_tpu else 32
-    bert_iters = 20 if on_tpu else 3
-    for dt_name in (("bfloat16",) if on_tpu else ("float32",)):
-        tok = _emit_with_retry(
-            "bert_base_pretrain_%s" % dt_name,
-            lambda dt_name=dt_name: bench_bert_base(
-                bert_bs, bert_seq, dtype=dt_name, iters=bert_iters),
-            attempts=3)
-        if tok is not None:
-            results["bert_base_%s" % dt_name] = tok
+    # bs=256 is the single-chip throughput knee with the r4 attention
+    # path (measured: 114k tok/s at bs128 -> 126k at bs256, down at
+    # bs384, compile-service OOM at bs512).  The seq sweep captures the
+    # XLA/Pallas crossover in the driver artifact itself: the auto path
+    # routes seq 128 to plain XLA attention and seq >= 256 to the Pallas
+    # flash kernels.
+    def _emit_bert(metric, bs, seq, dt_name, iters):
+        out = {}
+
+        def run():
+            tok, mfu = bench_bert_base(bs, seq, dtype=dt_name,
+                                       iters=iters)
+            out["mfu"] = mfu
+            return tok
+        val = _emit_with_retry(metric, run, attempts=3,
+                               extra_fn=lambda: {"mfu": out.get("mfu"),
+                                                 "seq_len": seq,
+                                                 "batch_size": bs})
+        return val
 
     if on_tpu:
+        tok = _emit_bert("bert_base_pretrain_bfloat16", 256, 128,
+                         "bfloat16", 12)
+        if tok is not None:
+            results["bert_base_bfloat16"] = tok
+        _emit_bert("bert_base_pretrain_seq512_bf16", 64, 512,
+                   "bfloat16", 10)
         # long-context config: seq 1024 is where the Pallas flash
         # fwd+bwd kernels pull away from XLA (81k vs 60k tok/s, r3)
-        _emit_with_retry(
-            "bert_base_pretrain_seq1024_bf16_flash",
-            lambda: bench_bert_base(16, 1024, dtype="bfloat16",
-                                    use_flash=True, iters=10))
+        _emit_bert("bert_base_pretrain_seq1024_bf16_flash", 16, 1024,
+                   "bfloat16", 10)
+    else:
+        _emit_bert("bert_base_pretrain_float32", 2, 32, "float32", 3)
 
     # BASELINE.md anchor: MXNet-CUDA A100 ResNet-50 ~3000 img/s (AMP+DALI)
     baseline = 3000.0

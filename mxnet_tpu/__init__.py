@@ -64,10 +64,15 @@ if _os.environ.get("MXNET_TPU_COMPILATION_CACHE", "1") != "0":
         _cache_root = _os.path.expanduser("~/.cache/mxnet_tpu/xla")
         _cache_dir = _os.path.join(_cache_root, _cache_fingerprint())
         # best-effort GC: prune sibling fingerprint dirs untouched for
-        # 30+ days (each rolling jaxlib/libtpu bump orphans one)
+        # 30+ days (each rolling jaxlib/libtpu bump orphans one).  Every
+        # import touches its OWN dir's mtime first, so a cache that is
+        # still in use anywhere (even read-only warm) stays fresh as
+        # long as its processes restart within the window.
         try:
             import shutil as _shutil
             import time as _time
+            if _os.path.isdir(_cache_dir):
+                _os.utime(_cache_dir, None)
             _cutoff = _time.time() - 30 * 86400
             for _d in _os.listdir(_cache_root):
                 _p = _os.path.join(_cache_root, _d)

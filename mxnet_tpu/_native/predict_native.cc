@@ -134,8 +134,14 @@ bool parse_tensor(const uint8_t* buf, uint64_t len, std::string* name,
   std::vector<int64_t> idata;
   while (r.next(&field, &wire, &pl, &n)) {
     switch (field) {
-      case 1:
-        if (wire == 0) t->shape.push_back(int64_t(n));
+      case 1:  // dims (proto3 serializers emit repeated int64 packed)
+        if (wire == 0) {
+          t->shape.push_back(int64_t(n));
+        } else if (wire == 2) {
+          Reader rr{pl, pl + n};
+          while (rr.p < rr.end && rr.ok)
+            t->shape.push_back(int64_t(rr.varint()));
+        }
         break;
       case 2:
         if (wire == 0) dtype = int32_t(n);

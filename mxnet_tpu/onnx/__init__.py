@@ -67,6 +67,35 @@ class _Exporter:
         self.graph_inputs = []   # ValueInfo bytes
         self.entry_name = {}     # (id(node), out_idx) -> tensor name
         self.counter = 0
+        self._ranks = None       # (id(node), out_idx) -> rank or None
+
+    def _internal_ranks(self):
+        """Best-effort rank map for every internal output, via partial
+        shape inference seeded with the graph-input shapes and the
+        (always-known) parameter shapes.  Unknowns map to None."""
+        if self._ranks is not None:
+            return self._ranks
+        self._ranks = {}
+        try:
+            kwargs = {}
+            in_idx = 0
+            for n in self.sym._topo():
+                if n.op is not None:
+                    continue
+                if n.name in self.params:
+                    kwargs[n.name] = tuple(self.params[n.name].shape)
+                else:
+                    if in_idx < len(self.in_shapes):
+                        kwargs[n.name] = tuple(self.in_shapes[in_idx])
+                    in_idx += 1
+            internals = self.sym.get_internals()
+            _, out_shapes, _ = internals.infer_shape_partial(**kwargs)
+            for (node, idx), shp in zip(internals._outputs, out_shapes):
+                self._ranks[(id(node), idx)] = \
+                    None if shp is None else len(shp)
+        except Exception:
+            pass
+        return self._ranks
 
     def fresh(self, base):
         self.counter += 1
@@ -286,6 +315,20 @@ class _Exporter:
                 _attr(node, "transpose_b", False):
             raise MXNetError("onnx export: %s with transpose_a/b is not "
                              "representable as MatMul" % node.op)
+        if node.op == "dot":
+            # mx dot is tensordot(axes=1); MatMul's numpy semantics agree
+            # only while the RHS has rank <= 2 (a rank>2 RHS makes MatMul
+            # broadcast batch dims instead of chaining them).  Verify via
+            # shape inference; reject rather than export silently wrong.
+            rb = self._internal_ranks().get(
+                (id(node.inputs[1][0]), node.inputs[1][1]))
+            if rb is None or rb > 2:
+                raise MXNetError(
+                    "onnx export: dot with a rank-%s second operand is "
+                    "not representable as MatMul (mx dot chains trailing "
+                    "dims, MatMul broadcasts batch dims); pass in_shapes "
+                    "proving rank <= 2 or rewrite with batch_dot"
+                    % ("unknown" if rb is None else rb))
         self.add_node("MatMul", [self.in_name(node, 0),
                                  self.in_name(node, 1)],
                       [node.name], node.name)

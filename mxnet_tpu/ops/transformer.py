@@ -107,10 +107,13 @@ def _interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
 # ----------------------------------------------------------------------
 
 def _attention_reference(q, k, v, causal, scale):
-    """Plain XLA attention (fallback + backward math)."""
+    """Plain XLA attention (fallback + backward math).  Matmuls run in
+    the input dtype with fp32 accumulation -- the MXU-native mode (a
+    bf16 x bf16 product is exact in fp32, so this matches an fp32
+    upcast to accumulation-order) -- softmax in fp32."""
     s = jax.lax.dot_general(
-        q.astype(jnp.float32), k.astype(jnp.float32),
-        (((2,), (2,)), ((0,), (0,)))) * scale
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale
     if causal:
         seq_q, seq_k = s.shape[-2], s.shape[-1]
         rows = jax.lax.broadcasted_iota(jnp.int32, (seq_q, seq_k), 0)
@@ -118,7 +121,8 @@ def _attention_reference(q, k, v, causal, scale):
         s = jnp.where(rows >= cols, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jax.lax.dot_general(
-        p, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))))
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
@@ -200,12 +204,13 @@ def _flash_masked(q, k, v, maskf, scale, block_q, block_k, use_pallas,
 
 def _attention_reference_masked(q, k, v, mask_bh, scale):
     s = jax.lax.dot_general(
-        q.astype(jnp.float32), k.astype(jnp.float32),
-        (((2,), (2,)), ((0,), (0,)))) * scale
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale
     s = jnp.where(mask_bh > 0, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jax.lax.dot_general(
-        p, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))))
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
 
@@ -260,24 +265,31 @@ def _flash_attention_op(q, k, v, causal=False, scale=-1.0, use_pallas=None,
     """Fused scaled-dot-product attention over (batch*heads, seq,
     head_dim) tensors.  ``use_pallas``: True = Pallas kernels (forward
     AND blockwise backward, O(seq*d) memory), False = XLA reference
-    path, None (default) = auto -- the choice is made per LOWERING
-    platform via ``lax.platform_dependent`` (Pallas everywhere but CPU),
-    so the same program picks the right kernel whether it lands on the
-    TPU or the CPU backend.  ``scale < 0`` means 1/sqrt(head_dim)."""
+    path (plain softmax attention, autodiffed by XLA -- the fastest
+    short-sequence path), None (default) = auto: above the measured
+    Pallas crossover (seq >= 256), ``lax.platform_dependent`` selects
+    the Pallas kernels when lowering for *tpu* and the portable XLA
+    path for every other platform; below it, the plain XLA path is
+    returned directly with no custom_vjp wrapper, so XLA saves the
+    softmax from the forward instead of recomputing it in the backward.
+    ``scale < 0`` means 1/sqrt(head_dim)."""
     if scale is None or scale < 0:
         scale = 1.0 / math.sqrt(q.shape[-1])
     causal, scale = bool(causal), float(scale)
     block_q, block_k = int(block_q), int(block_k)
-    if use_pallas is None and _auto_tileable(q.shape[1], block_q, block_k):
-        # custom_vjp functions take positional args only
-        return jax.lax.platform_dependent(
-            q, k, v,
-            cpu=lambda a, b, c: _flash(a, b, c, causal, scale, block_q,
-                                       block_k, False),
-            default=lambda a, b, c: _flash(a, b, c, causal, scale,
-                                           block_q, block_k, True))
-    return _flash(q, k, v, causal, scale, block_q, block_k,
-                  bool(use_pallas))
+    if use_pallas is None:
+        if _auto_tileable(q.shape[1], block_q, block_k):
+            # custom_vjp functions take positional args only
+            return jax.lax.platform_dependent(
+                q, k, v,
+                tpu=lambda a, b, c: _flash(a, b, c, causal, scale,
+                                           block_q, block_k, True),
+                default=lambda a, b, c: _attention_reference(
+                    a, b, c, causal, scale))
+        return _attention_reference(q, k, v, causal, scale)
+    if use_pallas:
+        return _flash(q, k, v, causal, scale, block_q, block_k, True)
+    return _attention_reference(q, k, v, causal, scale)
 
 
 @register("flash_attention_masked", args=("q", "k", "v", "mask"))
@@ -293,12 +305,20 @@ def _flash_attention_masked_op(q, k, v, mask, scale=-1.0, use_pallas=None,
     block_q, block_k = int(block_q), int(block_k)
     heads = int(heads)
     maskf = mask.astype(jnp.float32)
-    if use_pallas is None and _auto_tileable(q.shape[1], block_q, block_k):
-        return jax.lax.platform_dependent(
-            q, k, v, maskf,
-            cpu=lambda a, b, c, m: _flash_masked(
-                a, b, c, m, scale, block_q, block_k, False, heads),
-            default=lambda a, b, c, m: _flash_masked(
-                a, b, c, m, scale, block_q, block_k, True, heads))
-    return _flash_masked(q, k, v, maskf, scale, block_q, block_k,
-                         bool(use_pallas), heads)
+
+    def _xla_plain(a, b, c, m):
+        return _attention_reference_masked(
+            a, b, c, jnp.repeat(m, heads, axis=0), scale)
+
+    if use_pallas is None:
+        if _auto_tileable(q.shape[1], block_q, block_k):
+            return jax.lax.platform_dependent(
+                q, k, v, maskf,
+                tpu=lambda a, b, c, m: _flash_masked(
+                    a, b, c, m, scale, block_q, block_k, True, heads),
+                default=_xla_plain)
+        return _xla_plain(q, k, v, maskf)
+    if use_pallas:
+        return _flash_masked(q, k, v, maskf, scale, block_q, block_k,
+                             True, heads)
+    return _xla_plain(q, k, v, maskf)

@@ -3,9 +3,13 @@
 The reference's failure story is checkpoint/restart around engine
 crashes; the TPU-native analog is **preemption**: maintenance events
 deliver SIGTERM with a grace window.  ``install()`` arms a handler that,
-on signal, drains in-flight device work and writes the model parameters
-plus optimizer state, then lets the training loop exit cleanly via
-``handler.triggered``; ``resume()`` restores both on restart.
+on signal, marks ``handler.triggered``; the checkpoint (model parameters
+plus optimizer state) is written at the training loop's next *read* of
+``handler.triggered`` -- a loop boundary, so the save can never observe
+a torn, mid-``trainer.step()`` state the way an arbitrary-bytecode
+signal-path save could.  Loops that cannot poll can opt into the
+immediate in-handler save with ``save_in_handler=True``.  ``resume()``
+restores everything on restart.
 
 Checkpoint layout: ``<prefix>-preempt.params`` (block parameters) and
 ``<prefix>-preempt.states`` (Trainer/updater state), plus
@@ -37,19 +41,39 @@ class PreemptionHandler:
     """
 
     def __init__(self, prefix, block, trainer=None,
-                 signals=(signal.SIGTERM,), extra_state=None):
+                 signals=(signal.SIGTERM,), extra_state=None,
+                 save_in_handler=False, fallback_after=20.0):
         self.prefix = prefix
         self.block = block
         self.trainer = trainer
         self.extra_state = extra_state or {}
-        self.triggered = False
         self.saved = False
+        self.save_in_handler = save_in_handler
+        # Deferred saves rely on the loop polling ``triggered``; a loop
+        # blocked in a long dispatch would otherwise reach SIGKILL with
+        # nothing written.  The fallback timer fires a last-resort save
+        # after ``fallback_after`` seconds (None disables) -- possibly
+        # mid-step, but a boundary save that already happened wins.
+        self.fallback_after = fallback_after
+        self._fallback_timer = None
+        self._signal_seen = False
+        self._saving = False
         # RLock: the SIGTERM handler runs on the same thread and may
         # interrupt an explicit save_now() call mid-save
         self._lock = threading.RLock()
         self._prev = {}
         for sig in signals:
             self._prev[sig] = signal.signal(sig, self._on_signal)
+
+    @property
+    def triggered(self):
+        """True once a preemption signal arrived.  Reading this at the
+        loop boundary is what performs the (deferred) checkpoint write:
+        the state is guaranteed consistent there, unlike inside the
+        signal handler which may fire mid ``trainer.step()``."""
+        if self._signal_seen and not self.saved:
+            self.save_now()
+        return self._signal_seen
 
     # -- paths ---------------------------------------------------------
     @property
@@ -76,30 +100,44 @@ class PreemptionHandler:
         that loads truncated."""
         from . import ndarray as nd
         with self._lock:
-            if self.saved:
+            if self.saved or self._saving:
                 return
-            self.saved = True      # re-entrancy: signal during save
-            nd.waitall()           # drain the async queue first
+            self._saving = True    # re-entrancy: signal during save
+            try:
+                nd.waitall()       # drain the async queue first
 
-            def commit(path, write_fn):
-                tmp = "%s.%d.tmp" % (path, os.getpid())
-                write_fn(tmp)
-                os.replace(tmp, path)
+                def commit(path, write_fn):
+                    tmp = "%s.%d.tmp" % (path, os.getpid())
+                    write_fn(tmp)
+                    os.replace(tmp, path)
 
-            commit(self.params_path, self.block.save_parameters)
-            if self.trainer is not None:
-                commit(self.states_path, self.trainer.save_states)
-            meta = {"step": step, "extra": self.extra_state}
+                commit(self.params_path, self.block.save_parameters)
+                if self.trainer is not None:
+                    commit(self.states_path, self.trainer.save_states)
+                meta = {"step": step, "extra": self.extra_state}
 
-            def write_meta(tmp):
-                with open(tmp, "w") as f:
-                    json.dump(meta, f)
-            commit(self.meta_path, write_meta)
+                def write_meta(tmp):
+                    with open(tmp, "w") as f:
+                        json.dump(meta, f)
+                commit(self.meta_path, write_meta)
+                # only now: a failed write above leaves saved False so a
+                # later signal/save_now retries instead of silently
+                # skipping the one job this class has
+                self.saved = True
+            finally:
+                self._saving = False
 
     def _on_signal(self, signum, frame):
-        self.triggered = True
+        self._signal_seen = True
         try:
-            self.save_now()
+            if self.save_in_handler:
+                self.save_now()
+            elif self.fallback_after is not None \
+                    and self._fallback_timer is None:
+                t = threading.Timer(self.fallback_after, self.save_now)
+                t.daemon = True
+                t.start()
+                self._fallback_timer = t
         finally:
             prev = self._prev.get(signum)
             if callable(prev):
@@ -110,10 +148,14 @@ class PreemptionHandler:
             signal.signal(sig, prev if prev is not None
                           else signal.SIG_DFL)
         self._prev = {}
+        if self._fallback_timer is not None:
+            self._fallback_timer.cancel()
+            self._fallback_timer = None
 
 
 def install(prefix=None, block=None, trainer=None,
-            signals=(signal.SIGTERM,), extra_state=None):
+            signals=(signal.SIGTERM,), extra_state=None,
+            save_in_handler=False):
     """Arm SIGTERM-triggered checkpointing; returns the handler.
 
     With ``prefix=None`` the prefix comes from the
@@ -127,7 +169,8 @@ def install(prefix=None, block=None, trainer=None,
     if block is None:
         raise MXNetError("preemption.install needs the block to save")
     return PreemptionHandler(prefix, block, trainer, signals=signals,
-                             extra_state=extra_state)
+                             extra_state=extra_state,
+                             save_in_handler=save_in_handler)
 
 
 def resume(prefix, block, trainer=None, ctx=None):

@@ -172,3 +172,39 @@ def test_onnx_package_interop(tmp_path):
     onnx_file, _ = _roundtrip_block(net, x, tmp_path, "interop")
     model = onnx.load(onnx_file)
     onnx.checker.check_model(model)
+
+
+def test_dot_export_rank_guard(tmp_path):
+    """mx dot is tensordot(axes=1); ONNX MatMul diverges once the RHS
+    has rank > 2, so such exports must be rejected, not silently wrong.
+    Rank-2 dot exports fine and round-trips numerically."""
+    from mxnet_tpu.base import MXNetError
+    rng = np.random.RandomState(0)
+
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.dot(a, b)
+
+    # rank-2 x rank-2: representable; numeric round-trip
+    av = rng.randn(3, 4).astype(np.float32)
+    bv = rng.randn(4, 5).astype(np.float32)
+    f = str(tmp_path / "dot2.onnx")
+    export_model(out, {"b": mx.nd.array(bv)}, in_shapes=[av.shape],
+                 onnx_file_path=f)
+    isym, iargs, _iaux = import_model(f)
+    feeds = {k: v for k, v in iargs.items()}
+    feeds["a"] = mx.nd.array(av)
+    got = isym.eval(**feeds)[0].asnumpy()
+    np.testing.assert_allclose(got, av @ bv, rtol=1e-5, atol=1e-6)
+
+    # rank-3 RHS: MatMul would broadcast batch dims -> must raise
+    bv3 = rng.randn(2, 4, 5).astype(np.float32)
+    with pytest.raises(MXNetError):
+        export_model(out, {"b": mx.nd.array(bv3)},
+                     in_shapes=[(3, 2, 4)],
+                     onnx_file_path=str(tmp_path / "dot3.onnx"))
+
+    # unknown rank (no in_shapes): conservative rejection
+    with pytest.raises(MXNetError):
+        export_model(out, {}, in_shapes=None,
+                     onnx_file_path=str(tmp_path / "dotu.onnx"))
