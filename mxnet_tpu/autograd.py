@@ -143,6 +143,22 @@ def _toposort(head_arrays):
     return order[::-1]
 
 
+_LAZY_ADD = None
+
+
+def _ct_add(a, b):
+    """Cotangent accumulation, lazy-aware: pending bulked cotangents add
+    inside the queue instead of forcing a flush."""
+    from .ndarray import bulk
+    if isinstance(a, bulk.LazyData) or isinstance(b, bulk.LazyData):
+        global _LAZY_ADD
+        if _LAZY_ADD is None:
+            import jax as _jax
+            _LAZY_ADD = _jax.jit(lambda x, y: x + y)
+        return bulk.enqueue(_LAZY_ADD, "ct_add", (a, b))
+    return a + b
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Run backward from head arrays, accumulating into leaf ``.grad``.
 
@@ -184,7 +200,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             return
         key = id(arr)
         if key in leaf_acc:
-            leaf_acc[key] = (arr, leaf_acc[key][1] + ct)
+            leaf_acc[key] = (arr, _ct_add(leaf_acc[key][1], ct))
         else:
             leaf_acc[key] = (arr, ct)
 
@@ -203,7 +219,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         idx = h._ag_out_index
         g = _ones_on(h._data) if hg is None else hg._data
         node.out_grads[idx] = g if node.out_grads[idx] is None \
-            else node.out_grads[idx] + g
+            else _ct_add(node.out_grads[idx], g)
 
     for node in _toposort(heads):
         if all(g is None for g in node.out_grads):
@@ -213,7 +229,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 "backward through a graph that was already freed; pass "
                 "retain_graph=True to backward() to allow repeated calls")
         dev = next((next(iter(g.devices())) for g in node.out_grads
-                    if g is not None and len(g.devices()) == 1), None)
+                    if g is not None and hasattr(g, "devices")
+                    and len(g.devices()) == 1), None)
 
         def _zeros(shp, dt):
             if dev is not None:
@@ -236,7 +253,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if src is not None:
                 i = inp._ag_out_index
                 src.out_grads[i] = ct if src.out_grads[i] is None \
-                    else src.out_grads[i] + ct
+                    else _ct_add(src.out_grads[i], ct)
             elif getattr(inp, "_grad", None) is not None:
                 _to_leaf(inp, ct)
         # Cotangent slots always reset (a second backward must not see
@@ -247,7 +264,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     for arr, ct in leaf_acc.values():
         if arr._grad_req == "add":
-            arr._grad._data = arr._grad._data + ct
+            arr._grad._data = _ct_add(arr._grad._data, ct)
         else:
             arr._grad._data = ct
 

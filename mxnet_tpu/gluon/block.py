@@ -575,8 +575,11 @@ class HybridBlock(Block):
             diff_names = entry.diff_names
 
             def vjp_fn(cts):
+                from ..ndarray import bulk as _bulk
                 if not isinstance(cts, (tuple, list)):
                     cts = (cts,)
+                # cotangents may be pending bulked-eager placeholders
+                cts = tuple(_bulk.materialize(c) for c in cts)
                 aux_cts = {k: jnp.zeros(s, d)
                            for k, (s, d) in aux_zero_spec.items()}
                 d_diff, d_inputs = bwd(vjp, (tuple(cts), aux_cts))

@@ -43,7 +43,10 @@ def _decode_np(buf, flag=1):
                           _cv2.IMREAD_GRAYSCALE)
         if a is not None:
             if flag:
-                a = _cv2.cvtColor(a, _cv2.COLOR_BGR2RGB)
+                # BGR -> RGB as a zero-copy stride flip: the later
+                # transpose+cast pass materializes it, saving cvtColor's
+                # full-image pass
+                a = a[:, :, ::-1]
             else:
                 a = a[:, :, None]
             return a
@@ -216,17 +219,99 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+def _process_record_np(rec, data_shape, auglist, final_dtype, dst=None):
+    """One raw record (bytes) -> (CHW array, label): standalone so both
+    the thread pool and the process pool can run it.  With ``dst`` the
+    result is written (cast fused with the copy -- one memory pass)
+    into the given CHW buffer row and ``dst`` is returned."""
+    from ..recordio import unpack
+    header, payload = unpack(rec)
+    label = header.label
+    c, h, w = data_shape
+    payload = bytes(payload)
+    img = None
+    if len(payload) == c * h * w:
+        # raw (already-decoded) record: the im2rec --encoding .raw fast
+        # path.  Raw records carry no shape metadata -- data_shape IS
+        # the contract.  A payload that length-matches but starts with a
+        # codec signature is decoded instead; if that decode fails (raw
+        # pixels colliding with a 2-byte magic) it falls back to the
+        # raw reshape rather than aborting the epoch.
+        if not _looks_compressed(payload):
+            img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
+        else:
+            try:
+                img = _decode_np(payload, 1 if c == 3 else 0)
+            except Exception:
+                img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
+    else:
+        img = _decode_np(payload, 1 if c == 3 else 0)
+    for aug in auglist:
+        img = aug(img)               # numpy in -> numpy out (host-side)
+    a = _as_np(img)
+    if a.ndim == 3:
+        a = a.transpose(2, 0, 1)
+    if dst is not None:
+        np.copyto(dst, a, casting="unsafe")
+        return dst, label
+    if final_dtype is not None:
+        a = a.astype(final_dtype, copy=False)
+    return a, label
+
+
+# -- process-pool decode workers (reference: ImageRecordIOParser2's
+# C++ decode threads; here real processes so numpy augmenters scale
+# past the GIL, with a SharedMemory output slab as the cpu_shared
+# handoff) --------------------------------------------------------------
+
+_POOL_STATE = {}
+
+
+def _pool_worker_init(idx_path, rec_path, shm_name, slab_shape, slab_dtype,
+                      auglist, data_shape, final_dtype):
+    from multiprocessing import shared_memory
+    from ..recordio import MXIndexedRecordIO
+    np.random.seed((os.getpid() * 2654435761) % (2 ** 31))
+    shm = shared_memory.SharedMemory(name=shm_name)
+    _POOL_STATE["shm"] = shm
+    _POOL_STATE["slab"] = np.ndarray(slab_shape, dtype=slab_dtype,
+                                     buffer=shm.buf)
+    _POOL_STATE["rec"] = MXIndexedRecordIO(idx_path, rec_path, "r")
+    _POOL_STATE["args"] = (data_shape, auglist, final_dtype)
+
+
+def _pool_process_chunk(task):
+    offs, keys = task
+    data_shape, auglist, final_dtype = _POOL_STATE["args"]
+    rec = _POOL_STATE["rec"]
+    slab = _POOL_STATE["slab"]
+    labels = []
+    for o, k in zip(offs, keys):
+        _, label = _process_record_np(rec.read_idx(k), data_shape,
+                                      auglist, final_dtype, dst=slab[o])
+        labels.append(float(np.atleast_1d(np.asarray(label))[0]))
+    return offs, labels
+
+
 class ImageIter:
     """Legacy image iterator over .rec or .lst (reference: ``ImageIter``).
 
     Yields ``DataBatch``-like objects with CHW float data; sharding via
     num_parts/part_index as the reference's distributed input contract.
+
+    ``preprocess_threads`` fans decode+augment over threads (cv2
+    releases the GIL in the codec); ``preprocess_procs`` > 0 instead
+    uses a fork-based PROCESS pool with a SharedMemory output slab --
+    the numpy augmenters scale past the GIL, the decoded batch crosses
+    processes without pickling (the reference's cpu_shared storage
+    analog, ``cpu_shared_storage_manager.h``).
     """
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root="", aug_list=None,
                  shuffle=False, num_parts=1, part_index=0, label_width=1,
-                 preprocess_threads=4, dtype="float32", **kwargs):
+                 preprocess_threads=4, preprocess_procs=0,
+                 dtype="float32", **kwargs):
         from ..recordio import MXIndexedRecordIO
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
@@ -236,17 +321,28 @@ class ImageIter:
         self.dtype = np.dtype(dtype)
         # an explicit CastAug in a user-supplied aug_list wins over the
         # dtype parameter; for the default list the dtype parameter wins
-        # (and drops the redundant float32 CastAug)
+        # and the CastAug is dropped entirely -- the cast happens fused
+        # with the copy into the batch buffer (one memory pass, not two)
         if aug_list is None:
-            if self.dtype != np.float32:
-                self.auglist = [a for a in self.auglist
-                                if not isinstance(a, CastAug)]
+            self.auglist = [a for a in self.auglist
+                            if not isinstance(a, CastAug)]
             self._final_dtype = self.dtype
         else:
             self._final_dtype = None if any(
                 isinstance(a, CastAug) for a in self.auglist)                 else self.dtype
+        # dtype of the assembled batch buffer
+        self._batch_dtype = self._final_dtype
+        if self._batch_dtype is None:
+            self._batch_dtype = np.dtype("float32")
+            for a in self.auglist:
+                if isinstance(a, CastAug):
+                    self._batch_dtype = np.dtype(a.typ)
         self._pool = None
-        if preprocess_threads and preprocess_threads > 1:
+        self._proc_pool = None
+        self._shm = None
+        self._n_procs = int(preprocess_procs or 0)
+        if self._n_procs == 0 and preprocess_threads and \
+                preprocess_threads > 1:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(preprocess_threads)
         self._rec = None
@@ -267,7 +363,35 @@ class ImageIter:
             raise MXNetError("need path_imgrec or path_imglist")
         # distributed sharding (reference: num_parts/part_index kwargs)
         self._keys = keys[part_index::num_parts]
+        if self._n_procs > 0:
+            if self._rec is None:
+                raise MXNetError(
+                    "preprocess_procs needs path_imgrec (each worker "
+                    "process opens its own record reader)")
+            self._start_proc_pool(path_imgrec)
         self.reset()
+
+    def _start_proc_pool(self, path_imgrec):
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+        slab_dtype = self._batch_dtype
+        slab_shape = (self.batch_size,) + self.data_shape
+        self._slab_dtype = slab_dtype
+        self._shm = shared_memory.SharedMemory(
+            create=True,
+            size=int(np.prod(slab_shape)) * slab_dtype.itemsize)
+        self._slab = np.ndarray(slab_shape, dtype=slab_dtype,
+                                buffer=self._shm.buf)
+        idx_path = path_imgrec[:path_imgrec.rindex(".")] + ".idx"
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:  # pragma: no cover - non-POSIX fallback
+            ctx = mp.get_context()
+        self._proc_pool = ctx.Pool(
+            self._n_procs, initializer=_pool_worker_init,
+            initargs=(idx_path, path_imgrec, self._shm.name, slab_shape,
+                      slab_dtype, self.auglist, self.data_shape,
+                      self._final_dtype))
 
     def reset(self):
         self._order = np.random.permutation(len(self._keys)) if self.shuffle \
@@ -275,10 +399,22 @@ class ImageIter:
         self._cursor = 0
 
     def close(self):
-        """Release the record reader and the decode thread pool."""
+        """Release the record reader, decode pools, and shared slab."""
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
+        if self._proc_pool is not None:
+            self._proc_pool.terminate()
+            self._proc_pool.join()
+            self._proc_pool = None
+        if self._shm is not None:
+            self._slab = None
+            try:
+                self._shm.close()
+                self._shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+            self._shm = None
         if self._rec is not None:
             self._rec.close()
             self._rec = None
@@ -298,30 +434,8 @@ class ImageIter:
     def _process_record(self, rec):
         """One raw record (bytes) -> (CHW float array, label).  Pure
         host-side work: safe to fan out over the thread pool."""
-        from ..recordio import unpack
-        header, payload = unpack(rec)
-        label = header.label
-        c, h, w = self.data_shape
-        payload = bytes(payload)
-        if len(payload) == c * h * w:
-            # raw (already-decoded) record: the im2rec --encoding .raw
-            # fast path for hosts where codec throughput is the
-            # bottleneck.  Raw records carry no shape metadata --
-            # data_shape IS the contract.  A payload that length-matches
-            # but starts with a codec signature is decoded instead; if
-            # that decode fails (a raw image whose first pixels collide
-            # with a 2-byte magic) it falls back to the raw reshape
-            # rather than aborting the epoch.
-            if not _looks_compressed(payload):
-                img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
-                return self._augment(img), label
-            try:
-                img = _decode_np(payload, 1 if c == 3 else 0)
-            except Exception:
-                img = np.frombuffer(payload, np.uint8).reshape(h, w, c)
-            return self._augment(img), label
-        img = _decode_np(payload, 1 if c == 3 else 0)
-        return self._augment(img), label
+        return _process_record_np(rec, self.data_shape, self.auglist,
+                                  self._final_dtype)
 
     def _process_file(self, key):
         label, path = self._imglist[self._keys[key]]
@@ -361,28 +475,63 @@ class ImageIter:
         pad = max(0, self._cursor + self.batch_size - len(self._keys))
         idxs = [self._order[(self._cursor + i) % len(self._keys)]
                 for i in range(self.batch_size)]
+        if self._proc_pool is not None:
+            # process-pool mode: each worker reads its keys from its own
+            # reader and writes decoded images straight into the shared
+            # slab -- no record or image bytes cross a process boundary
+            keys = [self._keys[k] for k in idxs]
+            nchunks = min(self._n_procs, len(keys))
+            tasks = []
+            for ci in range(nchunks):
+                offs = list(range(ci, len(keys), nchunks))
+                tasks.append((offs, [keys[o] for o in offs]))
+            labels = np.empty(self.batch_size, np.float32)
+            for offs, ls in self._proc_pool.map(_pool_process_chunk,
+                                                tasks):
+                for o, l in zip(offs, ls):
+                    labels[o] = l
+            self._cursor += self.batch_size
+            if out is not None:
+                np.copyto(out, self._slab)
+                return out, labels, pad
+            return self._slab.copy(), labels, pad
+        # decode+augment writes straight into the batch buffer (cast
+        # fused with the copy) -- no per-image float temporaries, no
+        # np.stack pass
+        buf = out if out is not None else np.empty(
+            (self.batch_size,) + self.data_shape, self._batch_dtype)
         if self._rec is not None:
             # one thread-pooled native batch read of the record bytes
             # (the shared reader handle is NOT safe for concurrent
             # read_idx), then parallel decode+augment over the buffers
             recs = self._rec.read_batch([self._keys[k] for k in idxs])
+
+            def fill_rec(i):
+                _, label = _process_record_np(
+                    recs[i], self.data_shape, self.auglist,
+                    self._final_dtype, dst=buf[i])
+                return label
             if self._pool is not None:
-                results = list(self._pool.map(self._process_record, recs))
+                results = list(self._pool.map(fill_rec,
+                                              range(len(recs))))
             else:
-                results = [self._process_record(r) for r in recs]
-        elif self._pool is not None:
-            results = list(self._pool.map(self._process_file, idxs))
+                results = [fill_rec(i) for i in range(len(recs))]
         else:
-            results = [self._process_file(i) for i in idxs]
-        datas = [a for a, _ in results]
-        labels = [np.atleast_1d(np.asarray(l, np.float32))[0]
-                  for _, l in results]
+            def fill_file(args):
+                i, key = args
+                a, label = self._process_file(key)
+                np.copyto(buf[i], a, casting="unsafe")
+                return label
+            if self._pool is not None:
+                results = list(self._pool.map(fill_file,
+                                              enumerate(idxs)))
+            else:
+                results = [fill_file(x) for x in enumerate(idxs)]
+        labels = np.asarray(
+            [np.atleast_1d(np.asarray(l, np.float32))[0]
+             for l in results], np.float32)
         self._cursor += self.batch_size
-        if out is not None:
-            for i, a in enumerate(datas):
-                out[i] = a
-            return out, np.asarray(labels), pad
-        return np.stack(datas), np.asarray(labels), pad
+        return buf, labels, pad
 
     def __next__(self):
         data, labels, pad = self.next_np()

@@ -1,0 +1,209 @@
+"""Bulked eager dispatch: the TPU analog of the reference engine's
+operator bulking (``MXNET_EXEC_BULK_EXEC_TRAIN`` /
+``imperative_runtime.h :: DeferredComputation``).
+
+Eager op calls do not execute one XLA program each; they append to a
+process-wide queue of *pending* calls whose outputs are ``LazyData``
+placeholders (shape/dtype known from a per-signature aval cache, no
+tracing).  At a sync point -- ``asnumpy``/``asscalar``/``waitall``/any
+``_data`` read -- the whole pending region is replayed inside ONE jitted
+function, so XLA fuses across op boundaries and the host pays one
+dispatch instead of N.  The compiled replay program is cached on the
+structural key of the region (op signatures + wiring + input avals):
+a steady-state training loop compiles its region once and then replays.
+
+Correctness contract: device-side errors surface at the sync point, the
+same contract the async dependency engine gives the reference
+(``threaded_engine.cc :: WaitToRead``).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["LazyData", "enabled", "enqueue", "flush", "materialize"]
+
+_ENABLED = os.environ.get("MXNET_TPU_EAGER_BULK", "1") != "0"
+# capacity flush: bounds host memory for loops that never sync
+_MAX_PENDING = int(os.environ.get("MXNET_TPU_EAGER_BULK_MAX", "512"))
+
+
+def enabled():
+    return _ENABLED
+
+
+class LazyData:
+    """Placeholder for the output of a pending bulked op: carries the
+    aval (shape/dtype) so shape inference and ndarray properties never
+    force execution; ``materialize()`` flushes the queue."""
+
+    __slots__ = ("shape", "dtype", "slot", "_concrete", "device")
+
+    def __init__(self, shape, dtype, slot, device=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.slot = slot
+        self.device = device
+        self._concrete = None
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def materialize(self):
+        if self._concrete is None:
+            flush()
+        if self._concrete is None:
+            raise RuntimeError(
+                "LazyData %r was not resolved by flush(); its pending "
+                "region was lost (a prior flush may have failed)" % self)
+        return self._concrete
+
+    def __repr__(self):
+        state = "pending" if self._concrete is None else "resolved"
+        return "LazyData(%s, %s, %s)" % (self.shape, self.dtype, state)
+
+
+# -- queue state -------------------------------------------------------
+
+_entries = []          # [(fnc, key_tag, treedef, markers, out_slots, out_treedef)]
+_leaf_vals = []        # concrete leaf inputs for the current epoch
+_pending = []          # LazyData produced this epoch, slot-ordered
+_key_parts = []        # structural key accumulator
+_region_dev = None     # device token of the current region (mixed-device
+                       # regions would fail to jit as one program)
+
+_AVAL_CACHE = {}       # (key_tag, in_descr) -> (out_treedef, [(shape, dtype)])
+_FLUSH_CACHE = {}      # structural key -> jitted replay fn
+
+
+def _leaf_descr(x):
+    if isinstance(x, LazyData):
+        return ("lazyaval", x.shape, str(x.dtype))
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return ("arr", tuple(x.shape), str(x.dtype))
+    if isinstance(x, (bool, int, float, complex)):
+        return ("py", type(x).__name__)
+    return ("obj", type(x).__name__)
+
+
+def _in_descr(flat):
+    return tuple(_leaf_descr(x) for x in flat)
+
+
+def enqueue(fnc, key_tag, args, device=None):
+    """Append a call of ``fnc(*args)`` to the pending region and return
+    its outputs as a pytree of LazyData.  ``key_tag`` must uniquely and
+    stably identify ``fnc``'s computation (the eager-jit sig).
+
+    Falls back to executing immediately (returning concrete outputs)
+    when output avals for this (key_tag, input-aval) pair are not known
+    yet -- the warmup call doubles as the aval probe.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(args)
+    descr = _in_descr(flat)
+    aval_key = (key_tag, descr)
+    cached = _AVAL_CACHE.get(aval_key)
+    if cached is None:
+        # warmup: run now (also compiles fnc) and record output avals
+        out = fnc(*_resolve_args(args))
+        oflat, otree = jax.tree_util.tree_flatten(out)
+        _AVAL_CACHE[aval_key] = (otree, [(tuple(o.shape), o.dtype)
+                                         for o in oflat])
+        return out
+
+    # one region = one device: a pending region whose leaves span
+    # devices cannot execute as a single jitted program
+    global _region_dev
+    tok = None
+    if device is not None:
+        tok = (device,)
+    else:
+        for x in flat:
+            if isinstance(x, jax.Array):
+                tok = tuple(sorted(x.devices(), key=lambda d: d.id))
+                break
+            if isinstance(x, LazyData) and x._concrete is None \
+                    and x.device is not None:
+                tok = (x.device,)
+                break
+    if _entries and tok is not None and _region_dev is not None \
+            and tok != _region_dev:
+        flush()
+    if tok is not None and not _entries:
+        _region_dev = tok
+
+    out_treedef, out_avals = cached
+    markers = []
+    for x in flat:
+        if isinstance(x, LazyData) and x._concrete is None:
+            markers.append(("slot", x.slot))
+            if device is None:
+                device = x.device
+        else:
+            if isinstance(x, LazyData):
+                x = x._concrete
+            markers.append(("leaf", len(_leaf_vals)))
+            _leaf_vals.append(x)
+    out_slots = []
+    outs = []
+    for shape, dtype in out_avals:
+        slot = len(_pending)
+        ld = LazyData(shape, dtype, slot, device=device)
+        _pending.append(ld)
+        out_slots.append(slot)
+        outs.append(ld)
+    _entries.append((fnc, treedef, tuple(markers), tuple(out_slots),
+                     out_treedef))
+    _key_parts.append((key_tag, treedef, tuple(markers), descr))
+    if len(_entries) >= _MAX_PENDING:
+        flush()
+    return jax.tree_util.tree_unflatten(out_treedef, outs)
+
+
+def _resolve_args(args):
+    return jax.tree_util.tree_map(
+        lambda x: x.materialize() if isinstance(x, LazyData) else x,
+        args, is_leaf=lambda x: isinstance(x, LazyData))
+
+
+def _build_replay(entries, n_slots):
+    def replay(leaf_vals):
+        env = [None] * n_slots
+        for fnc, treedef, markers, out_slots, _otree in entries:
+            flat = [env[i] if kind == "slot" else leaf_vals[i]
+                    for kind, i in markers]
+            args = jax.tree_util.tree_unflatten(treedef, flat)
+            out = fnc(*args)
+            oflat, _ = jax.tree_util.tree_flatten(out)
+            for s, v in zip(out_slots, oflat):
+                env[s] = v
+        return env
+    return replay
+
+
+def flush():
+    """Execute the pending region as one jitted program and resolve
+    every LazyData produced this epoch."""
+    global _entries, _leaf_vals, _pending, _key_parts
+    if not _entries:
+        return
+    entries, leaf_vals, pending = _entries, _leaf_vals, _pending
+    key = tuple(_key_parts)
+    _entries, _leaf_vals, _pending, _key_parts = [], [], [], []
+    jrep = _FLUSH_CACHE.get(key)
+    if jrep is None:
+        jrep = jax.jit(_build_replay(entries, len(pending)))
+        _FLUSH_CACHE[key] = jrep
+    vals = jrep(leaf_vals)
+    for ld, v in zip(pending, vals):
+        ld._concrete = v
+
+
+def materialize(x):
+    """Concrete value of ``x`` (a LazyData or anything already real)."""
+    if isinstance(x, LazyData):
+        return x.materialize()
+    return x

@@ -31,6 +31,7 @@ from .. import random as _random_mod
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops.registry import Op, get_op
+from . import bulk
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
            "concat", "concatenate", "save", "load", "invoke", "waitall",
@@ -58,6 +59,7 @@ def waitall():
     failed cannot be resurrected, but every live array's pending work is
     drained and the first failure propagates.
     """
+    bulk.flush()
     if hasattr(jax, "effects_barrier"):
         jax.effects_barrier()
     for d in jax.live_arrays():
@@ -79,30 +81,53 @@ def _amp_active():
 class NDArray:
     """An n-dimensional array on a device context."""
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_ag_node", "_ag_out_index",
+    __slots__ = ("_buf", "_grad", "_grad_req", "_ag_node", "_ag_out_index",
                  "__weakref__")
 
     def __init__(self, data, ctx=None):
         if isinstance(data, NDArray):
-            data = data._data
-        if ctx is not None and not _is_traced(data):
+            data = data._buf
+        if isinstance(data, bulk.LazyData):
+            if data._concrete is not None:
+                data = data._concrete
+            elif ctx is not None:
+                data = jax.device_put(data.materialize(), ctx.jax_device())
+        elif ctx is not None and not _is_traced(data):
             data = jax.device_put(jnp.asarray(data), ctx.jax_device())
         elif not isinstance(data, jax.Array) and not _is_traced(data):
             data = jnp.asarray(data)
-        self._data = data
+        self._buf = data
         self._grad = None
         self._grad_req = "write"
         self._ag_node = None
         self._ag_out_index = 0
 
+    # -- data handle ---------------------------------------------------
+    # ``_data`` is the concrete jax.Array handle; reading it is a sync
+    # point for the bulked eager queue (the reference's WaitToRead).
+    # Shape/dtype queries go through ``_buf`` and never force execution.
+    @property
+    def _data(self):
+        buf = self._buf
+        if isinstance(buf, bulk.LazyData):
+            buf = buf.materialize()
+            self._buf = buf
+        return buf
+
+    @_data.setter
+    def _data(self, value):
+        if isinstance(value, bulk.LazyData) and value._concrete is not None:
+            value = value._concrete
+        self._buf = value
+
     # -- basic properties ---------------------------------------------
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        return np.dtype(self._data.dtype)
+        return np.dtype(self._buf.dtype)
 
     @property
     def size(self):
@@ -110,7 +135,7 @@ class NDArray:
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self._buf.shape)
 
     @property
     def stype(self):
@@ -584,11 +609,12 @@ _DYNAMIC_PARAMS = frozenset(("lr", "wd", "rescale_grad", "scalar"))
 
 
 def _eager_jit_fn(op, params, present, total_args):
-    """Return ``(jfn, dyn_names)`` -- a cached jitted callable plus the
-    names of params it takes as traced scalars -- or ``(None, ())`` when
-    the call is unjittable (unhashable params)."""
+    """Return ``(jfn, dyn_names, sig)`` -- a cached jitted callable, the
+    names of params it takes as traced scalars, and the cache key -- or
+    ``(None, (), None)`` when the call is unjittable (unhashable
+    params)."""
     if not _EAGER_JIT_ENABLED:
-        return None, ()
+        return None, (), None
     dyn_names = tuple(sorted(
         k for k in params
         if k in _DYNAMIC_PARAMS and isinstance(params[k], (int, float))
@@ -599,12 +625,12 @@ def _eager_jit_fn(op, params, present, total_args):
                             if k not in dyn_names))
         hash(psig)
     except TypeError:
-        return None, ()
+        return None, (), None
     from .. import amp as _amp
     amp_token = _amp.policy_token() if _amp_active() else None
     sig = (op.name, present, total_args, psig, dyn_names, amp_token)
-    jfn = _EAGER_JIT_CACHE.get(sig)
-    if jfn is None:
+    entry = _EAGER_JIT_CACHE.get(sig)
+    if entry is None:
         fcompute = op.fcompute
         stateful = op.stateful_rng
         opname = op.name
@@ -629,9 +655,40 @@ def _eager_jit_fn(op, params, present, total_args):
                 return fcompute(rng_key, *full, **kwargs)
             return fcompute(*full, **kwargs)
 
-        jfn = jax.jit(f)
-        _EAGER_JIT_CACHE[sig] = jfn
-    return jfn, dyn_names
+        entry = (jax.jit(f), f, stateful)
+        _EAGER_JIT_CACHE[sig] = entry
+    return entry[0], dyn_names, sig
+
+
+# Per-sig cached BACKWARD executables for recorded eager ops.  Without
+# this every `autograd.record()`-scoped op call pays a fresh jax.vjp
+# trace -- the dominant term of the imperative/hybridized gap (SURVEY
+# §7 hard-part #1).  The cached backward is recompute-based (jax.vjp of
+# the forward inside one jit, cotangents applied in the same program):
+# the op's residuals are rebuilt from its inputs, trading a little FLOP
+# for never tracing at dispatch time -- the per-op analog of
+# ``jax.checkpoint``.
+_EAGER_BWD_CACHE = {}
+
+
+def _eager_bwd_fn(sig):
+    bwd = _EAGER_BWD_CACHE.get(sig)
+    if bwd is None:
+        _jfn, f, stateful = _EAGER_JIT_CACHE[sig]
+
+        def b(dyn_vals, key, pd, cts):
+            if stateful:
+                def fwd(*p):
+                    return f(dyn_vals, key, *p)
+            else:
+                def fwd(*p):
+                    return f(dyn_vals, *p)
+            _, pull = jax.vjp(fwd, *pd)
+            return pull(cts)
+
+        bwd = jax.jit(b)
+        _EAGER_BWD_CACHE[sig] = bwd
+    return bwd
 
 
 def invoke(op: Op, tensor_args, kwargs, out=None):
@@ -650,11 +707,22 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
 
     # single-device reference only: committing a converted operand to
     # one device of a SHARDED operand's set would break the jit call
-    ref_device = next((next(iter(a._data.devices()))
-                       for a in tensor_args
-                       if isinstance(a, NDArray)
-                       and not _is_traced(a._data)
-                       and len(a._data.devices()) == 1), None)
+    ref_device = None
+    for a in tensor_args:
+        if not isinstance(a, NDArray):
+            continue
+        b = a._buf
+        if isinstance(b, bulk.LazyData):
+            if b._concrete is not None:
+                b = b._concrete
+            elif b.device is not None:
+                ref_device = b.device
+                break
+            else:
+                continue
+        if not _is_traced(b) and len(b.devices()) == 1:
+            ref_device = next(iter(b.devices()))
+            break
     nds = []
     datas = []
     for a in tensor_args:
@@ -663,7 +731,11 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
             datas.append(None)
         elif isinstance(a, NDArray):
             nds.append(a)
-            datas.append(a._data)
+            b = a._buf
+            if isinstance(b, bulk.LazyData) and b._concrete is not None:
+                b = b._concrete
+                a._buf = b
+            datas.append(b)
         else:
             # place converted operands WITH the tensor operands -- the
             # default device may be a remote TPU, and a stray transfer
@@ -679,24 +751,37 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
     present = tuple(i for i, d in enumerate(datas) if d is not None)
     pdatas = [datas[i] for i in present]
 
-    jfn, dyn_names = _eager_jit_fn(op, params, present, len(datas))
+    jfn, dyn_names, sig = _eager_jit_fn(op, params, present, len(datas))
     if jfn is not None:
         dyn_vals = tuple(float(params[n]) for n in dyn_names)
         call = functools.partial(jfn, dyn_vals, key) if op.stateful_rng \
             else functools.partial(jfn, dyn_vals)
     else:
-        # unjittable params (rare): eager fallback
+        # unjittable params (rare): eager fallback -- needs concrete data
+        datas = [bulk.materialize(d) for d in datas]
         fn = functools.partial(op.fcompute, key) if op.stateful_rng \
             else op.fcompute
 
         def call(*pd):
             full = list(datas)
             for i, d in zip(present, pd):
-                full[i] = d
+                full[i] = bulk.materialize(d)
             if _amp_active():
                 from .. import amp as _amp
                 full = _amp.apply_op_casts(op.name, full)
             return fn(*full, **params)
+
+    # bulked dispatch: append to the pending region instead of launching
+    # one XLA program per op (reference: engine op bulking)
+    bulkable = (jfn is not None and bulk.enabled()
+                and not any(_is_traced(d) for d in pdatas))
+
+    def dispatch():
+        if bulkable:
+            args = ((dyn_vals, key) + tuple(pdatas)) if op.stateful_rng \
+                else ((dyn_vals,) + tuple(pdatas))
+            return bulk.enqueue(jfn, sig, args, device=ref_device)
+        return call(*pdatas)
 
     from .. import profiler as _profiler
     scope = _profiler.scope("mx." + op.name) \
@@ -705,16 +790,37 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
         n is not None and n._is_tracked() for n in nds)
     with scope:
         if recording:
-            raw, vjp_fn = jax.vjp(call, *pdatas)
+            if jfn is not None:
+                # cached-executable forward + cached recompute-based
+                # backward: no tracing on either pass after warmup
+                raw = dispatch()
+                bwd = _eager_bwd_fn(sig)
+                pd_tuple = tuple(pdatas)
+                dv, kk = dyn_vals, key
+
+                def vjp_fn(cts):
+                    if bulk.enabled():
+                        # backward bulking: the cached bwd executable
+                        # joins the pending region like any forward op
+                        return bulk.enqueue(bwd, ("bwd", sig),
+                                            (dv, kk, pd_tuple, cts))
+                    pd = tuple(bulk.materialize(x) for x in pd_tuple)
+                    cts_c = jax.tree_util.tree_map(
+                        bulk.materialize, cts,
+                        is_leaf=lambda x: isinstance(x, bulk.LazyData))
+                    return bwd(dv, kk, pd, cts_c)
+            else:
+                raw, vjp_fn = jax.vjp(
+                    call, *[bulk.materialize(d) for d in pdatas])
             tape_inputs = [nds[i] for i in present]
             result = _wrap_outputs(op, raw, tape_inputs, vjp_fn, params)
         else:
-            raw = call(*pdatas)
+            raw = dispatch()
             result = _wrap_outputs(op, raw, None, None, params)
 
     if out is not None:
         src = result if not isinstance(result, list) else result[0]
-        out._data = src._data
+        out._buf = src._buf
         out._ag_node = src._ag_node
         out._ag_out_index = src._ag_out_index
         return out

@@ -277,8 +277,14 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     # while the mean/var math is exact enough.
     xf = data.astype(jnp.float32)
     if training and not use_global_stats:
+        # E[x^2] - E[x]^2: the two reductions are independent, so XLA
+        # fuses them into ONE read pass over the activation (jnp.var's
+        # (x - mean)^2 form depends on the mean and forces a second
+        # pass).  fp32 accumulation keeps the cancellation benign for
+        # unit-scale post-conv activations.
         mean = jnp.mean(xf, axis=reduce_axes)
-        var = jnp.var(xf, axis=reduce_axes)
+        m2 = jnp.mean(xf * xf, axis=reduce_axes)
+        var = jnp.maximum(m2 - mean * mean, 0.0)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
     else:

@@ -369,6 +369,7 @@ class TrainStep:
         thread through the on-device loop.
         """
         from .. import amp as _amp
+        from ..ndarray import bulk as _bulk
         tr = self._trainer
         opt = tr._optimizer
         if getattr(tr, "_amp_loss_scaler", None) is not None:
@@ -443,6 +444,9 @@ class TrainStep:
                 rescale, loss_scale)
         self._last_call = (fn, jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
+        # the jit donates the param/state buffers; any still-pending
+        # bulked-eager region referencing them must execute first
+        _bulk.flush()
         new_w, new_s, _t, losses = fn(*args)
         for n in pnames:
             pmap[n]._data._data = new_w[n]
@@ -479,6 +483,7 @@ class TrainStep:
     # -- call ----------------------------------------------------------
     def __call__(self, data, label, batch_size=None):
         from .. import autograd as _ag
+        from ..ndarray import bulk as _bulk
         tr = self._trainer
         opt = tr._optimizer
         # value dtype must match the declared Parameter dtype BEFORE
@@ -551,6 +556,9 @@ class TrainStep:
                 rescale, loss_scale)
         self._last_call = (fn, jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args))
+        # the jit donates the param/state buffers; any still-pending
+        # bulked-eager region referencing them must execute first
+        _bulk.flush()
         new_w, new_s, aux, mean_loss, all_finite = fn(*args)
         if scaler is not None:
             # host sync only in fp16 mode: the scaler's growth/backoff

@@ -144,3 +144,38 @@ def test_uint8_batch_trains(tmp_path):
     y = mx.nd.array(np.zeros((2,), np.float32))
     l = float(step(x, y).asscalar())
     assert np.isfinite(l)
+
+
+def test_process_pool_decode_matches_serial(tmp_path):
+    """preprocess_procs: fork workers decode into the SharedMemory slab;
+    batches must match the serial path exactly (deterministic augs)."""
+    p = str(tmp_path / "procjpg")
+    _build(p, 24, "jpg")
+
+    def run(**kw):
+        it = ImageIter(8, (3, 48, 48), path_imgrec=p + ".rec", **kw)
+        try:
+            out = []
+            while True:
+                d, l, _pad = it.next_np()
+                out.append((d.copy(), l.copy()))
+        except StopIteration:
+            return out
+        finally:
+            it.close()
+
+    serial = run(preprocess_threads=0)
+    pooled = run(preprocess_procs=2)
+    assert len(serial) == len(pooled) == 3
+    for (d0, l0), (d1, l1) in zip(serial, pooled):
+        np.testing.assert_array_equal(l0, l1)
+        np.testing.assert_allclose(d0, d1)
+
+
+def test_process_pool_requires_recordio(tmp_path):
+    lst = tmp_path / "x.lst"
+    lst.write_text("0\t1.0\tnope.jpg\n")
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        ImageIter(2, (3, 8, 8), path_imglist=str(lst),
+                  preprocess_procs=2)

@@ -47,9 +47,14 @@ def test_launch_local_env():
          "os.environ['MXNET_TPU_NUM_PROCS'])"],
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 0, out.stderr
-    ranks = sorted(line.split()[0] for line in
-                   out.stdout.strip().splitlines())
+    # the launcher relays each worker line atomically with a "[rank] "
+    # prefix (dmlc tracker behavior), so lines can never interleave
+    lines = out.stdout.strip().splitlines()
+    assert all(line.startswith("[") for line in lines), lines
+    ranks = sorted(line.split()[1] for line in lines)
     assert ranks == ["0", "1"]
+    prefixes = sorted(line.split()[0] for line in lines)
+    assert prefixes == ["[0]", "[1]"]
 
 
 def test_opperf_runs():

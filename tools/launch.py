@@ -23,6 +23,34 @@ import shlex
 import socket
 import subprocess
 import sys
+import threading
+
+_print_lock = threading.Lock()
+
+
+def _relay(pipe, prefix):
+    """Line-buffered prefixed relay (the dmlc tracker behavior): each
+    worker line becomes ONE atomic write under a lock, so two workers'
+    output can never interleave mid-line."""
+    out = sys.stdout.buffer
+    with pipe:
+        for line in iter(pipe.readline, b""):
+            if not line.endswith(b"\n"):
+                line += b"\n"
+            with _print_lock:
+                out.write(prefix + line)
+                out.flush()
+
+
+def _spawn_relayed(cmd, env, rank):
+    p = subprocess.Popen(cmd, env=env, start_new_session=True,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT)
+    t = threading.Thread(target=_relay,
+                         args=(p.stdout, b"[%d] " % rank), daemon=True)
+    t.start()
+    p._relay_thread = t
+    return p
 
 
 def _free_port():
@@ -68,6 +96,9 @@ def _wait_all(procs):
                 if rc is None:
                     continue
                 procs.remove(p)
+                t = getattr(p, "_relay_thread", None)
+                if t is not None:
+                    t.join(timeout=10)
                 if rc != 0:
                     _kill_tree(procs)
                     return rc
@@ -91,8 +122,7 @@ def launch_local(args, command):
             "DMLC_ROLE": "worker",
             "DMLC_NUM_WORKER": str(args.num_workers),
         })
-        procs.append(subprocess.Popen(command, env=env,
-                                      start_new_session=True))
+        procs.append(_spawn_relayed(command, env, rank))
     return _wait_all(procs)
 
 
@@ -118,9 +148,9 @@ def launch_ssh(args, command):
         ])
         remote = "cd %s && env %s %s" % (
             shlex.quote(cwd), envs, " ".join(map(shlex.quote, command)))
-        procs.append(subprocess.Popen(
+        procs.append(_spawn_relayed(
             ["ssh", "-o", "StrictHostKeyChecking=no", "-tt", host,
-             remote]))
+             remote], None, rank))
     # -tt allocates a tty so terminating the ssh client also kills the
     # remote command instead of orphaning it
     return _wait_all(procs)
