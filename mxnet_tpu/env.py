@@ -83,6 +83,16 @@ _VARS = [
            "Checkpoint prefix used by mx.preemption.install() when no "
            "prefix argument is given: SIGTERM drains pending work and "
            "writes <prefix>-preempt.params/.states/.meta before exit."),
+    EnvVar("MXNET_TPU_EAGER_BULK", bool, True,
+           "Bulked eager dispatch: queue eager ops and replay the whole "
+           "pending region as ONE jitted program at the next sync point "
+           "(the reference's MXNET_EXEC_BULK_EXEC_TRAIN analog).  '0' "
+           "dispatches each eager op individually."),
+    EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
+           "Capacity flush threshold for the bulked eager queue: a "
+           "pending region is flushed once it reaches this many ops, "
+           "bounding host memory for loops that never sync (reference: "
+           "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)."),
 ]
 
 REGISTRY = {v.name: v for v in _VARS}

@@ -19,6 +19,7 @@ same contract the async dependency engine gives the reference
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 import numpy as np
@@ -37,24 +38,41 @@ def enabled():
 class LazyData:
     """Placeholder for the output of a pending bulked op: carries the
     aval (shape/dtype) so shape inference and ndarray properties never
-    force execution; ``materialize()`` flushes the queue."""
+    force execution; ``materialize()`` flushes the queue.
 
-    __slots__ = ("shape", "dtype", "slot", "_concrete", "device")
+    If the op that produces this value failed during flush, the
+    exception is captured on ``_error`` and re-raised at every read --
+    the reference's captured-exception contract
+    (``threaded_engine.cc :: OnCompleteStatic``)."""
 
-    def __init__(self, shape, dtype, slot, device=None):
+    __slots__ = ("shape", "dtype", "slot", "_concrete", "device",
+                 "_error", "_region")
+
+    def __init__(self, shape, dtype, slot, device=None, region=None):
         self.shape = tuple(shape)
         self.dtype = dtype
         self.slot = slot
         self.device = device
         self._concrete = None
+        self._error = None
+        self._region = region
 
     @property
     def ndim(self):
         return len(self.shape)
 
     def materialize(self):
-        if self._concrete is None:
+        if self._concrete is None and self._error is None:
             flush()
+            if self._concrete is None and self._error is None \
+                    and self._region is not None:
+                # our region was swapped out by another thread's flush
+                # and is executing there; wait for its completion event
+                # (set in flush's finally, so this can't hang on a
+                # failed replay)
+                self._region.done.wait()
+        if self._error is not None:
+            raise self._error
         if self._concrete is None:
             raise RuntimeError(
                 "LazyData %r was not resolved by flush(); its pending "
@@ -62,11 +80,32 @@ class LazyData:
         return self._concrete
 
     def __repr__(self):
-        state = "pending" if self._concrete is None else "resolved"
+        state = "failed" if self._error is not None else \
+            ("pending" if self._concrete is None else "resolved")
         return "LazyData(%s, %s, %s)" % (self.shape, self.dtype, state)
 
 
+class _Region:
+    """Identity + completion event for one pending region: enqueue only
+    slot-wires LazyData belonging to the CURRENT region; readers of a
+    region being executed by another thread wait on ``done``."""
+
+    __slots__ = ("done",)
+
+    def __init__(self):
+        self.done = threading.Event()
+
+
 # -- queue state -------------------------------------------------------
+# One process-wide region guarded by _LOCK: any thread may enqueue
+# (DataLoader workers touching mx.nd, Horovod callbacks) and any thread
+# may flush (a cross-thread materialize of a handed-off NDArray).  The
+# RLock makes that safe -- enqueue's warmup path can recursively flush
+# on the same thread.  Ops from different threads may interleave in one
+# region; replay respects the slot-level data dependencies, and eager
+# ops are pure, so interleaving only affects the structural key.
+
+_LOCK = threading.RLock()
 
 _entries = []          # [(fnc, key_tag, treedef, markers, out_slots, out_treedef)]
 _leaf_vals = []        # concrete leaf inputs for the current epoch
@@ -74,9 +113,24 @@ _pending = []          # LazyData produced this epoch, slot-ordered
 _key_parts = []        # structural key accumulator
 _region_dev = None     # device token of the current region (mixed-device
                        # regions would fail to jit as one program)
+_cur_region = _Region()
 
 _AVAL_CACHE = {}       # (key_tag, in_descr) -> (out_treedef, [(shape, dtype)])
 _FLUSH_CACHE = {}      # structural key -> jitted replay fn
+# programs with data-dependent sync points generate unbounded distinct
+# region keys; bound both caches with FIFO eviction (an evicted aval
+# entry just re-warms; an evicted replay fn just re-jits)
+_CACHE_MAX = 1024
+# sentinel for region keys whose jitted replay failed deterministically:
+# later flushes of the same key skip the (expensive) re-trace attempt
+# and go straight to the eager fallback
+_FAILED = object()
+
+
+def _cache_put(cache, key, val):
+    if len(cache) >= _CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[key] = val
 
 
 def _leaf_descr(x):
@@ -102,65 +156,86 @@ def enqueue(fnc, key_tag, args, device=None):
     when output avals for this (key_tag, input-aval) pair are not known
     yet -- the warmup call doubles as the aval probe.
     """
-    flat, treedef = jax.tree_util.tree_flatten(args)
-    descr = _in_descr(flat)
-    aval_key = (key_tag, descr)
-    cached = _AVAL_CACHE.get(aval_key)
-    if cached is None:
-        # warmup: run now (also compiles fnc) and record output avals
-        out = fnc(*_resolve_args(args))
-        oflat, otree = jax.tree_util.tree_flatten(out)
-        _AVAL_CACHE[aval_key] = (otree, [(tuple(o.shape), o.dtype)
-                                         for o in oflat])
-        return out
+    with _LOCK:
+        flat, treedef = jax.tree_util.tree_flatten(args)
+        descr = _in_descr(flat)
+        aval_key = (key_tag, descr)
+        cached = _AVAL_CACHE.get(aval_key)
+        if cached is None:
+            # warmup: run now (also compiles fnc) and record output avals
+            out = fnc(*_resolve_args(args))
+            oflat, otree = jax.tree_util.tree_flatten(out)
+            _cache_put(_AVAL_CACHE, aval_key,
+                       (otree, [(tuple(o.shape), o.dtype) for o in oflat]))
+            return out
 
-    # one region = one device: a pending region whose leaves span
-    # devices cannot execute as a single jitted program
-    global _region_dev
-    tok = None
-    if device is not None:
-        tok = (device,)
-    else:
-        for x in flat:
-            if isinstance(x, jax.Array):
-                tok = tuple(sorted(x.devices(), key=lambda d: d.id))
-                break
-            if isinstance(x, LazyData) and x._concrete is None \
-                    and x.device is not None:
-                tok = (x.device,)
-                break
-    if _entries and tok is not None and _region_dev is not None \
-            and tok != _region_dev:
-        flush()
-    if tok is not None and not _entries:
-        _region_dev = tok
+        # a pending input may be unusable as a slot wire: it failed in a
+        # prior flush (must re-raise ITS error, not wire a stale slot
+        # index into this region) or it belongs to a region another
+        # thread swapped out and is executing.  Resolve those up front
+        # -- materialize waits/raises as appropriate.  This runs BEFORE
+        # the device-token logic because it can flush (resetting the
+        # region state the token check reads).
+        def _stale(x):
+            return (isinstance(x, LazyData) and x._concrete is None
+                    and (x._error is not None
+                         or x._region is not _cur_region))
+        if any(_stale(x) for x in flat):
+            flat = [x.materialize() if _stale(x) else x for x in flat]
 
-    out_treedef, out_avals = cached
-    markers = []
-    for x in flat:
-        if isinstance(x, LazyData) and x._concrete is None:
-            markers.append(("slot", x.slot))
-            if device is None:
-                device = x.device
+        # one region = one device: a pending region whose leaves span
+        # devices cannot execute as a single jitted program
+        global _region_dev
+        tok = None
+        if device is not None:
+            tok = (device,)
         else:
-            if isinstance(x, LazyData):
-                x = x._concrete
-            markers.append(("leaf", len(_leaf_vals)))
-            _leaf_vals.append(x)
-    out_slots = []
-    outs = []
-    for shape, dtype in out_avals:
-        slot = len(_pending)
-        ld = LazyData(shape, dtype, slot, device=device)
-        _pending.append(ld)
-        out_slots.append(slot)
-        outs.append(ld)
-    _entries.append((fnc, treedef, tuple(markers), tuple(out_slots),
-                     out_treedef))
-    _key_parts.append((key_tag, treedef, tuple(markers), descr))
-    if len(_entries) >= _MAX_PENDING:
+            for x in flat:
+                if isinstance(x, jax.Array):
+                    tok = tuple(sorted(x.devices(), key=lambda d: d.id))
+                    break
+                if isinstance(x, LazyData) and x._concrete is None \
+                        and x.device is not None:
+                    tok = (x.device,)
+                    break
+        if _entries and tok is not None and _region_dev is not None \
+                and tok != _region_dev:
+            flush()
+        if tok is not None and not _entries:
+            _region_dev = tok
+
+        out_treedef, out_avals = cached
+        markers = []
+        for x in flat:
+            if isinstance(x, LazyData) and x._concrete is None:
+                markers.append(("slot", x.slot))
+                if device is None:
+                    device = x.device
+            else:
+                if isinstance(x, LazyData):
+                    x = x._concrete
+                markers.append(("leaf", len(_leaf_vals)))
+                _leaf_vals.append(x)
+        out_slots = []
+        outs = []
+        for shape, dtype in out_avals:
+            slot = len(_pending)
+            ld = LazyData(shape, dtype, slot, device=device,
+                          region=_cur_region)
+            _pending.append(ld)
+            out_slots.append(slot)
+            outs.append(ld)
+        _entries.append((fnc, treedef, tuple(markers), tuple(out_slots),
+                         out_treedef))
+        _key_parts.append((key_tag, treedef, tuple(markers), descr))
+        need_flush = len(_entries) >= _MAX_PENDING
+        result = jax.tree_util.tree_unflatten(out_treedef, outs)
+    # the capacity flush (the NORMAL flush trigger for long loops) runs
+    # outside the lock so its region execution doesn't serialize other
+    # threads' eager dispatch
+    if need_flush:
         flush()
-    return jax.tree_util.tree_unflatten(out_treedef, outs)
+    return result
 
 
 def _resolve_args(args):
@@ -184,22 +259,104 @@ def _build_replay(entries, n_slots):
     return replay
 
 
+def _replay_eager(entries, leaf_vals, n_slots):
+    """Un-jitted op-by-op replay, used when the jitted replay fails:
+    the failing op raises its OWN error; ops not downstream of it still
+    resolve; downstream ops inherit the upstream exception."""
+    env = [None] * n_slots
+    errs = [None] * n_slots
+    first_err = None
+    for fnc, treedef, markers, out_slots, _otree in entries:
+        up_err = None
+        flat = []
+        for kind, i in markers:
+            if kind == "slot":
+                if errs[i] is not None and up_err is None:
+                    up_err = errs[i]
+                flat.append(env[i])
+            else:
+                flat.append(leaf_vals[i])
+        if up_err is None:
+            try:
+                out = fnc(*jax.tree_util.tree_unflatten(treedef, flat))
+                oflat, _ = jax.tree_util.tree_flatten(out)
+                for s, v in zip(out_slots, oflat):
+                    env[s] = v
+                continue
+            except Exception as e:   # noqa: BLE001 -- captured contract
+                up_err = e
+                if first_err is None:
+                    first_err = e
+        for s in out_slots:
+            errs[s] = up_err
+    return env, errs, first_err
+
+
 def flush():
     """Execute the pending region as one jitted program and resolve
     every LazyData produced this epoch."""
-    global _entries, _leaf_vals, _pending, _key_parts
-    if not _entries:
-        return
-    entries, leaf_vals, pending = _entries, _leaf_vals, _pending
-    key = tuple(_key_parts)
-    _entries, _leaf_vals, _pending, _key_parts = [], [], [], []
-    jrep = _FLUSH_CACHE.get(key)
-    if jrep is None:
-        jrep = jax.jit(_build_replay(entries, len(pending)))
-        _FLUSH_CACHE[key] = jrep
-    vals = jrep(leaf_vals)
-    for ld, v in zip(pending, vals):
-        ld._concrete = v
+    global _entries, _leaf_vals, _pending, _key_parts, _region_dev, \
+        _cur_region
+    with _LOCK:
+        if not _entries:
+            return
+        entries, leaf_vals, pending = _entries, _leaf_vals, _pending
+        key = tuple(_key_parts)
+        reg = _cur_region
+        _entries, _leaf_vals, _pending, _key_parts = [], [], [], []
+        _region_dev = None
+        _cur_region = _Region()
+        jrep = _FLUSH_CACHE.get(key)
+        fresh = jrep is None
+        if fresh:
+            # jax.jit construction is lazy -- trace/compile happen at
+            # the call below, OUTSIDE the lock
+            jrep = jax.jit(_build_replay(entries, len(pending)))
+            _cache_put(_FLUSH_CACHE, key, jrep)
+    # Execution runs outside the lock so other threads keep enqueueing
+    # into the fresh region; cross-thread readers of THIS region's
+    # LazyData wait on reg.done (see materialize).  The finally
+    # guarantees waiters wake even when the replay fails.
+    try:
+        vals = None
+        if jrep is not _FAILED:
+            try:
+                vals = jrep(leaf_vals)
+            except Exception:
+                # Poison the key only when THIS flush created the jit
+                # wrapper: a first-call failure is a trace/compile
+                # failure that would re-pay the full trace on every
+                # flush.  A previously-warm jrep that fails was
+                # compiled and ran before -- the failure is transient
+                # (device OOM spike) and the key stays jittable.
+                # (No lock: CPython dict writes are atomic, and taking
+                # _LOCK here could deadlock against an enqueue waiting
+                # on reg.done.)
+                if fresh:
+                    _FLUSH_CACHE[key] = _FAILED
+        if vals is not None:
+            for ld, v in zip(pending, vals):
+                ld._concrete = v
+            return
+        # The jitted replay failed (compile error, device OOM, a
+        # runtime check): fall back to eager replay so the failing
+        # op surfaces its own error at THIS sync point and every
+        # LazyData not downstream of it still resolves (reference:
+        # threaded_engine.cc :: OnCompleteStatic re-throws the
+        # captured exception at WaitToRead).
+        vals, errs, first_err = _replay_eager(entries, leaf_vals,
+                                              len(pending))
+        for ld, v, e in zip(pending, vals, errs):
+            ld._concrete = v
+            ld._error = e
+        if first_err is not None:
+            raise first_err
+        # every op ran clean eagerly, so the jitted failure was
+        # transient (first-call OOM spike, compile-service drop): drop
+        # the poisoned/failed cache entry so the key re-jits next flush
+        _FLUSH_CACHE.pop(key, None)
+    finally:
+        reg.done.set()
 
 
 def materialize(x):
@@ -207,3 +364,11 @@ def materialize(x):
     if isinstance(x, LazyData):
         return x.materialize()
     return x
+
+
+def materialize_tree(tree):
+    """``materialize`` mapped over a pytree, treating LazyData as
+    leaves (the shared idiom for making cotangent/operand trees
+    concrete before handing them to a raw ``jax.vjp`` pull)."""
+    return jax.tree_util.tree_map(
+        materialize, tree, is_leaf=lambda x: isinstance(x, LazyData))

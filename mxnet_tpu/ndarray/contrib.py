@@ -48,12 +48,19 @@ class _FlowOp:
 def _dispatch(name, pure_fn, inputs):
     """Run a pure multi-in/multi-out function with tape integration,
     mirroring ``invoke``'s recording semantics for a fused construct."""
+    from . import bulk
     from .ndarray import _wrap_outputs
     vals = tuple(a._data for a in inputs)
     recording = autograd.is_recording() and \
         any(a._is_tracked() for a in inputs)
     if recording:
-        raw, vjp_fn = jax.vjp(pure_fn, *vals)
+        raw, pull = jax.vjp(pure_fn, *vals)
+
+        def vjp_fn(cts):
+            # cotangents may arrive as pending bulk.LazyData (bulked
+            # backward of downstream eager ops); a raw jax.vjp pull is
+            # not LazyData-aware, so materialize before pulling
+            return pull(bulk.materialize_tree(cts))
         return _wrap_outputs(_FlowOp(name), list(raw), list(inputs),
                              vjp_fn, {})
     return _wrap_outputs(_FlowOp(name), list(pure_fn(*vals)), None, None,

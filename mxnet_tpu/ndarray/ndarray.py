@@ -799,19 +799,30 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
                 dv, kk = dyn_vals, key
 
                 def vjp_fn(cts):
-                    if bulk.enabled():
+                    cts_flat, _ = jax.tree_util.tree_flatten(
+                        cts, is_leaf=lambda x: isinstance(x, bulk.LazyData))
+                    traced = any(_is_traced(x) for x in pd_tuple) or \
+                        any(_is_traced(x) for x in cts_flat)
+                    if bulk.enabled() and not traced:
                         # backward bulking: the cached bwd executable
-                        # joins the pending region like any forward op
+                        # joins the pending region like any forward op.
+                        # Traced operands (backward replayed under an
+                        # outer jax trace) must NOT enter the module
+                        # queue: they would leak out of the trace and
+                        # x.devices() on a tracer raises -- mirror the
+                        # forward's bulkable guard and call directly.
                         return bulk.enqueue(bwd, ("bwd", sig),
                                             (dv, kk, pd_tuple, cts))
                     pd = tuple(bulk.materialize(x) for x in pd_tuple)
-                    cts_c = jax.tree_util.tree_map(
-                        bulk.materialize, cts,
-                        is_leaf=lambda x: isinstance(x, bulk.LazyData))
-                    return bwd(dv, kk, pd, cts_c)
+                    return bwd(dv, kk, pd, bulk.materialize_tree(cts))
             else:
-                raw, vjp_fn = jax.vjp(
+                raw, pull = jax.vjp(
                     call, *[bulk.materialize(d) for d in pdatas])
+
+                def vjp_fn(cts, _pull=pull):
+                    # same LazyData hazard as the jitted path: bulked
+                    # cotangents must be concrete before the raw pull
+                    return _pull(bulk.materialize_tree(cts))
             tape_inputs = [nds[i] for i in present]
             result = _wrap_outputs(op, raw, tape_inputs, vjp_fn, params)
         else:

@@ -277,14 +277,21 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     # while the mean/var math is exact enough.
     xf = data.astype(jnp.float32)
     if training and not use_global_stats:
-        # E[x^2] - E[x]^2: the two reductions are independent, so XLA
-        # fuses them into ONE read pass over the activation (jnp.var's
-        # (x - mean)^2 form depends on the mean and forces a second
-        # pass).  fp32 accumulation keeps the cancellation benign for
-        # unit-scale post-conv activations.
-        mean = jnp.mean(xf, axis=reduce_axes)
-        m2 = jnp.mean(xf * xf, axis=reduce_axes)
-        var = jnp.maximum(m2 - mean * mean, 0.0)
+        # Shifted one-pass moments: E[(x-c)^2] - E[x-c]^2 with the
+        # per-channel shift c = moving_mean.  The two reductions are
+        # independent, so XLA fuses them into ONE read pass over the
+        # activation (jnp.var's (x - mean)^2 form depends on the mean
+        # and forces a second pass); the shift bounds the catastrophic
+        # cancellation of the naive E[x^2]-E[x]^2 form when |mean| >>
+        # std (large-offset inputs), since moving_mean tracks the batch
+        # mean and |E[x-c]| stays near zero in steady state.
+        c = lax.stop_gradient(moving_mean.astype(jnp.float32)) \
+            .reshape(bshape)
+        y = xf - c
+        mean_y = jnp.mean(y, axis=reduce_axes)
+        m2 = jnp.mean(y * y, axis=reduce_axes)
+        var = jnp.maximum(m2 - mean_y * mean_y, 0.0)
+        mean = mean_y + c.reshape(mean_y.shape)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
     else:

@@ -53,9 +53,11 @@ class PreemptionHandler:
         # blocked in a long dispatch would otherwise reach SIGKILL with
         # nothing written.  The fallback timer fires a last-resort save
         # after ``fallback_after`` seconds (None disables) -- possibly
-        # mid-step, but a boundary save that already happened wins.
+        # mid-step, so it is PROVISIONAL: it does not set ``saved``, and
+        # a later consistent boundary save overwrites it.
         self.fallback_after = fallback_after
         self._fallback_timer = None
+        self._fallback_saved = False
         self._signal_seen = False
         self._saving = False
         # RLock: the SIGTERM handler runs on the same thread and may
@@ -89,10 +91,15 @@ class PreemptionHandler:
         return self.prefix + "-preempt.meta"
 
     # -- save ----------------------------------------------------------
-    def save_now(self, step=None):
+    def save_now(self, step=None, provisional=False):
         """Drain pending device work and write the checkpoint.  Safe to
         call directly (e.g. at epoch boundaries) as well as from the
         signal path.
+
+        ``provisional=True`` (the fallback timer's mode) marks a save
+        that may have caught a mid-step state: it is written, but it
+        does NOT set ``saved``, so the next boundary-triggered save
+        re-saves a consistent snapshot over it.
 
         Files are written to temp paths and renamed into place, with
         the meta file LAST -- ``resume`` gates on the meta file, so a
@@ -102,9 +109,27 @@ class PreemptionHandler:
         with self._lock:
             if self.saved or self._saving:
                 return
+            if provisional and self._fallback_saved:
+                return
             self._saving = True    # re-entrancy: signal during save
             try:
                 nd.waitall()       # drain the async queue first
+                if self._fallback_saved and not provisional:
+                    # re-arm the meta-last atomicity gate before
+                    # overwriting a provisional checkpoint: otherwise a
+                    # SIGKILL mid-re-save could leave NEW params beside
+                    # the OLD provisional states/meta, and resume()
+                    # (which trusts the meta file) would load a
+                    # mismatched pair.  Runs AFTER waitall so a device
+                    # error cannot destroy the provisional checkpoint
+                    # before the re-save even starts -- and clearing
+                    # _fallback_saved lets the fallback path rewrite a
+                    # checkpoint if THIS save fails partway.
+                    self._fallback_saved = False
+                    try:
+                        os.remove(self.meta_path)
+                    except FileNotFoundError:
+                        pass
 
                 def commit(path, write_fn):
                     tmp = "%s.%d.tmp" % (path, os.getpid())
@@ -122,8 +147,13 @@ class PreemptionHandler:
                 commit(self.meta_path, write_meta)
                 # only now: a failed write above leaves saved False so a
                 # later signal/save_now retries instead of silently
-                # skipping the one job this class has
-                self.saved = True
+                # skipping the one job this class has.  A provisional
+                # (possibly torn) fallback save never sets saved -- only
+                # a boundary save ends the retry loop.
+                if provisional:
+                    self._fallback_saved = True
+                else:
+                    self.saved = True
             finally:
                 self._saving = False
 
@@ -134,7 +164,8 @@ class PreemptionHandler:
                 self.save_now()
             elif self.fallback_after is not None \
                     and self._fallback_timer is None:
-                t = threading.Timer(self.fallback_after, self.save_now)
+                t = threading.Timer(self.fallback_after, self.save_now,
+                                    kwargs={"provisional": True})
                 t.daemon = True
                 t.start()
                 self._fallback_timer = t

@@ -171,3 +171,31 @@ print("CHECKPOINTED after", i, "steps", flush=True)
     assert os.path.exists(prefix + "-preempt.params")
     meta = json.load(open(prefix + "-preempt.meta"))
     assert "step" in meta
+
+
+def test_fallback_save_is_provisional(tmp_path):
+    """A fallback-timer save may catch a torn mid-step state, so it
+    must NOT satisfy the handler: the next consistent boundary save
+    re-saves over it (advisor r4: the old behavior let the torn
+    checkpoint win permanently)."""
+    prefix = str(tmp_path / "fb")
+    net, tr = _net_and_trainer()
+    x = mx.nd.array(np.random.randn(4, 6).astype(np.float32))
+    net(x)
+    handler = mx.preemption.install(prefix, net, tr)
+    try:
+        # simulate the fallback timer firing mid-step
+        handler.save_now(provisional=True)
+        assert os.path.exists(handler.params_path)
+        assert not handler.saved        # provisional: job not done
+        first_mtime = os.path.getmtime(handler.meta_path)
+        # a second fallback fire is a no-op
+        handler.save_now(provisional=True)
+        assert os.path.getmtime(handler.meta_path) == first_mtime
+        # the boundary save overwrites the provisional checkpoint
+        handler.save_now(step=7)
+        assert handler.saved
+        meta = json.load(open(handler.meta_path))
+        assert meta["step"] == 7
+    finally:
+        handler.uninstall()

@@ -366,3 +366,23 @@ def test_cast_bf16():
     assert str(b.dtype) == "bfloat16"
     back = mx.nd.Cast(b, dtype="float32")
     assert back.asnumpy().tolist() == [1, 1, 1, 1]
+
+
+def test_batchnorm_large_offset_stability():
+    """The fused one-pass moments are shifted by moving_mean so a
+    large common offset (|mean| >> std) cannot catastrophically cancel
+    the variance in fp32 (advisor r4: the naive E[x^2]-E[x]^2 form
+    clamps var to 0 here and scales by 1/sqrt(eps))."""
+    off = 1.0e4
+    x = (np.random.randn(8, 3, 6, 6) + off).astype(np.float32)
+    g = np.ones(3, np.float32)
+    b = np.zeros(3, np.float32)
+    mm = np.full(3, off, np.float32)    # steady-state moving mean
+    mv = np.ones(3, np.float32)
+    out, _, _ = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g),
+                                mx.nd.array(b), mx.nd.array(mm),
+                                mx.nd.array(mv), fix_gamma=False,
+                                training=True)
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-2
+    assert abs(o.std(axis=(0, 2, 3)) - 1).max() < 0.05
