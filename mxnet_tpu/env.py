@@ -88,6 +88,11 @@ _VARS = [
            "pending region as ONE jitted program at the next sync point "
            "(the reference's MXNET_EXEC_BULK_EXEC_TRAIN analog).  '0' "
            "dispatches each eager op individually."),
+    EnvVar("MXNET_TPU_BENCH_BUDGET_S", float, 1500.0,
+           "Wall-clock budget (seconds) for bench.py: headline metrics "
+           "emit first, and optional configs that would exceed the "
+           "budget print a skipped line instead of running (so the "
+           "bench can never outlive the driver's timeout)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "
