@@ -224,11 +224,10 @@ def _process_record_np(rec, data_shape, auglist, final_dtype, dst=None):
     the thread pool and the process pool can run it.  With ``dst`` the
     result is written (cast fused with the copy -- one memory pass)
     into the given CHW buffer row and ``dst`` is returned."""
-    from ..recordio import unpack
-    header, payload = unpack(rec)
+    from ..recordio import _unpack_view
+    header, payload = _unpack_view(rec)   # zero-copy payload view
     label = header.label
     c, h, w = data_shape
-    payload = bytes(payload)
     img = None
     if len(payload) == c * h * w:
         # raw (already-decoded) record: the im2rec --encoding .raw fast
@@ -301,10 +300,10 @@ class ImageIter:
 
     ``preprocess_threads`` fans decode+augment over threads (cv2
     releases the GIL in the codec); ``preprocess_procs`` > 0 instead
-    uses a fork-based PROCESS pool with a SharedMemory output slab --
-    the numpy augmenters scale past the GIL, the decoded batch crosses
-    processes without pickling (the reference's cpu_shared storage
-    analog, ``cpu_shared_storage_manager.h``).
+    uses a forkserver-based PROCESS pool with a SharedMemory output
+    slab -- the numpy augmenters scale past the GIL, the decoded batch
+    crosses processes without pickling (the reference's cpu_shared
+    storage analog, ``cpu_shared_storage_manager.h``).
     """
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
@@ -383,15 +382,37 @@ class ImageIter:
         self._slab = np.ndarray(slab_shape, dtype=slab_dtype,
                                 buffer=self._shm.buf)
         idx_path = path_imgrec[:path_imgrec.rindex(".")] + ".idx"
+        # forkserver: workers fork from a CLEAN server process (itself
+        # launched by exec), never from this process -- forking a
+        # JAX-multithreaded process is deadlock-prone (os.fork
+        # RuntimeWarning; reference took the same hazard seriously in
+        # initialize.cc :: LibraryInitializer's fork handlers).  The
+        # initargs (augmenter list included) travel by pickle, which
+        # they support.
         try:
-            ctx = mp.get_context("fork")
+            ctx = mp.get_context("forkserver")
         except ValueError:  # pragma: no cover - non-POSIX fallback
-            ctx = mp.get_context()
+            ctx = mp.get_context("spawn")
+        # forkserver/spawn workers re-execute __main__ when it has a
+        # __file__; a parent launched from stdin or a notebook cell has
+        # the bogus path '<stdin>', which makes every worker crash on
+        # import and the pool respawn forever (a hang, not an error).
+        # The workers only need _pool_worker_init from THIS importable
+        # module, so drop the unloadable __file__ -- permanently, not
+        # just for the initial spawn: the Pool's maintenance thread
+        # respawns dead workers later, and a restored bogus path would
+        # resurrect the hang then.  A path that doesn't exist can never
+        # be loaded by anyone, so removing it loses nothing.
+        import sys as _sys
+        main_mod = _sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        if main_file is not None and not os.path.exists(main_file):
+            del main_mod.__file__
         self._proc_pool = ctx.Pool(
             self._n_procs, initializer=_pool_worker_init,
-            initargs=(idx_path, path_imgrec, self._shm.name, slab_shape,
-                      slab_dtype, self.auglist, self.data_shape,
-                      self._final_dtype))
+            initargs=(idx_path, path_imgrec, self._shm.name,
+                      slab_shape, slab_dtype, self.auglist,
+                      self.data_shape, self._final_dtype))
 
     def reset(self):
         self._order = np.random.permutation(len(self._keys)) if self.shuffle \

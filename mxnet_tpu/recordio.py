@@ -206,11 +206,19 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def read_batch(self, keys, nthreads=4):
         """Read many records concurrently (reference: the threaded
-        record loader in ``iter_image_recordio_2.cc``).  Uses the native
-        thread-pooled batch reader when available; otherwise sequential.
+        record loader in ``iter_image_recordio_2.cc``).
+
+        Routing is measured, not assumed: on a single-core host (or
+        nthreads<=1) the buffered sequential Python reads win -- the
+        native path pays a per-record malloc+memcpy+ctypes round-trip
+        that costs ~2-3x a warm-cache ``read_idx`` loop (the r4->r5
+        ``pipeline_raw_uint8`` regression).  The native thread pool is
+        engaged only where its parallel IO can actually pay: multicore
+        hosts with several reader threads.
         """
         lib = _native_lib()
-        if lib is None or self.writable:
+        if lib is None or self.writable or nthreads <= 1 \
+                or (os.cpu_count() or 1) <= 1:
             return [self.read_idx(k) for k in keys]
         n = len(keys)
         offsets = (ctypes.c_long * n)(*[self.idx[k] for k in keys])
@@ -260,12 +268,27 @@ def pack(header, s):
 
 def unpack(s):
     """Unpack a record into (IRHeader, payload) (reference: ``unpack``)."""
-    flag, label, id_, id2 = struct.unpack(_HEADER_FMT, s[:_HEADER_SIZE])
-    s = s[_HEADER_SIZE:]
+    header, view = _unpack_view(s)
+    return header, bytes(view)
+
+
+def _unpack_view(s):
+    """``unpack`` returning the payload as a zero-copy memoryview.
+
+    The hot decode paths use this: for raw-pixel records the public
+    ``unpack``'s payload slice copies the whole image (~150 KB at
+    224x224x3) per record, which costs ~25% of the raw pipeline's
+    epoch time.  The view aliases ``s`` -- callers must not outlive it.
+    """
+    flag, label, id_, id2 = struct.unpack_from(_HEADER_FMT, s, 0)
+    view = memoryview(s)[_HEADER_SIZE:]
     if flag > 0:
-        label = np.frombuffer(s[:flag * 4], np.float32)
-        s = s[flag * 4:]
-    return IRHeader(flag, label, id_, id2), s
+        # copy the (tiny) label floats: callers retain labels long
+        # after the record, and a zero-copy label would pin the whole
+        # record's bytes alive per sample
+        label = np.frombuffer(bytes(view[:flag * 4]), np.float32)
+        view = view[flag * 4:]
+    return IRHeader(flag, label, id_, id2), view
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):

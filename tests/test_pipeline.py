@@ -147,8 +147,13 @@ def test_uint8_batch_trains(tmp_path):
 
 
 def test_process_pool_decode_matches_serial(tmp_path):
-    """preprocess_procs: fork workers decode into the SharedMemory slab;
-    batches must match the serial path exactly (deterministic augs)."""
+    """preprocess_procs: forkserver workers decode into the
+    SharedMemory slab; batches must match the serial path exactly
+    (deterministic augs).  The pool must NOT fork this
+    (JAX-multithreaded) process: the os.fork RuntimeWarning is
+    escalated to an error here (VERDICT r4 #5 -- the fork-based pool
+    was a deadlock time bomb)."""
+    import warnings
     p = str(tmp_path / "procjpg")
     _build(p, 24, "jpg")
 
@@ -165,7 +170,13 @@ def test_process_pool_decode_matches_serial(tmp_path):
             it.close()
 
     serial = run(preprocess_threads=0)
-    pooled = run(preprocess_procs=2)
+    with warnings.catch_warnings():
+        # CPython emits the multithreaded-fork hazard as
+        # DeprecationWarning (3.12+) and RuntimeWarning in other
+        # paths/versions; escalate any fork warning
+        warnings.filterwarnings("error", message=".*fork.*",
+                                category=Warning)
+        pooled = run(preprocess_procs=2)
     assert len(serial) == len(pooled) == 3
     for (d0, l0), (d1, l1) in zip(serial, pooled):
         np.testing.assert_array_equal(l0, l1)
