@@ -134,21 +134,42 @@ def _warn_kv_fallback():
             "tests, never the real-pod path")
 
 
+def _result_device(arr):
+    """Device the collective's result should land on: the INPUT's
+    device when it is a jax array.  ``jnp.asarray`` would place the
+    result on the DEFAULT device instead -- on this environment that is
+    a remote tunneled TPU even under JAX_PLATFORMS=cpu, so an
+    unplaced result drags every later use through the tunnel."""
+    import jax
+    if isinstance(arr, jax.Array):
+        return next(iter(arr.devices()))
+    return None
+
+
+def _place(x, dev):
+    import jax
+    import jax.numpy as jnp
+    return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+
+
 def host_allreduce(arr, average=False, timeout_ms=60000):
     """Sum (or mean) a host array across every process.  Uses backend
     collectives when the backend is multi-process; otherwise the
-    coordination-service KV store."""
+    coordination-service KV store.  The result lands on the input's
+    device (see ``_result_device``)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    dev = _result_device(arr)
     nproc, rank = world()
     if nproc == 1:
-        return jnp.asarray(arr)
+        return _place(arr, dev)
     if jax.process_count() == nproc:
         from jax.experimental import multihost_utils
         g = multihost_utils.process_allgather(jnp.asarray(arr))
-        return jnp.mean(g, axis=0) if average else jnp.sum(g, axis=0)
+        out = jnp.mean(g, axis=0) if average else jnp.sum(g, axis=0)
+        return _place(out, dev)
     _warn_kv_fallback()
     client = _client()
     x = np.asarray(arr)
@@ -164,17 +185,25 @@ def host_allreduce(arr, average=False, timeout_ms=60000):
     _gc_old_keys(client)
     if average:
         total = total / nproc
-    return jnp.asarray(total)
+    return _place(total, dev)
 
 
 def host_broadcast(arr, root=0, timeout_ms=60000):
-    """Every process receives root's value."""
+    """Every process receives root's value (placed on the input's
+    device, see ``_result_device``)."""
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
+    dev = _result_device(arr)
     nproc, rank = world()
     if nproc == 1:
-        return jnp.asarray(arr)
+        return _place(arr, dev)
+    if jax.process_count() == nproc:
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            jnp.asarray(arr), is_source=(rank == root))
+        return _place(out, dev)
     _warn_kv_fallback()
     client = _client()
     x = np.asarray(arr)
@@ -194,7 +223,7 @@ def host_broadcast(arr, root=0, timeout_ms=60000):
             client.key_value_delete(tag)
         except Exception:
             pass
-    return jnp.asarray(out)
+    return _place(out, dev)
 
 
 def barrier(name="mxnet_tpu_barrier", timeout_ms=60000):

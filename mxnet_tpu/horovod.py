@@ -62,7 +62,11 @@ def broadcast_parameters(params, root_rank=0):
     items = params.items() if hasattr(params, "items") else params
     for _name, p in items:
         arr = p.data() if hasattr(p, "data") else p
-        arr._data = host_broadcast(np.asarray(arr._data), root_rank)
+        # pass the device array through: host_broadcast places its
+        # result back on the input's device (an np.asarray here would
+        # both force a host fetch per parameter and land the result on
+        # the DEFAULT device -- a remote TPU on tunneled hosts)
+        arr._data = host_broadcast(arr._data, root_rank)
 
 
 class DistributedTrainer(Trainer):

@@ -18,6 +18,12 @@ Design (SURVEY.md §5 "Distributed communication backend"):
   optimizer" of the reference (``kvstore_dist_server.h :: DataHandleEx``)
   becomes a replicated update after the allreduce -- same contract
   (workers see identical post-update weights), no server role needed.
+  ``dist_async`` shares this path by DESIGN: the reference's async mode
+  exists to hide ps-lite server latency by applying per-worker pushes
+  without aggregation (stale weights as the price); with XLA's async
+  dispatch the allreduce itself is non-blocking until a sync point, so
+  the latency-hiding is already had WITHOUT giving up synchronous
+  semantics -- async here means async dispatch, not weight staleness.
 - Gradient compression hook mirrors ``gradient_compression.cc`` (2bit with
   error feedback) as a pre-allreduce transform.
 """
@@ -230,10 +236,15 @@ class KVStore:
         if row_ids is None:
             return self.pull(key, out, priority)
         rows = row_ids._data if isinstance(row_ids, NDArray) else row_ids
-        # dedup host-side (reference PullRowSparse dedups): duplicate ids
-        # would double rows under the sparse todense() scatter-add
-        rows = jnp.asarray(np.unique(np.asarray(rows).astype(np.int32)))
         full = self._store[key]._data
+        # dedup host-side (reference PullRowSparse dedups): duplicate ids
+        # would double rows under the sparse todense() scatter-add.
+        # Place the ids WITH the table: jnp.asarray would put them on
+        # the DEFAULT device (a remote TPU here), dragging the gather
+        # through the tunnel per pull
+        rows = np.unique(np.asarray(rows).astype(np.int32))
+        rows = jax.device_put(rows, next(iter(full.devices()))) \
+            if isinstance(full, jax.Array) else jnp.asarray(rows)
         picked_rows = full[rows]                      # (k, ...) gather only
         if out is None:
             return _sp.RowSparseNDArray(picked_rows, rows,

@@ -165,6 +165,16 @@ class NDArray:
         """Blocking copy to host (reference: ``MXNDArraySyncCopyToCPU``)."""
         return np.asarray(self._data)
 
+    def __array__(self, dtype=None, copy=None):
+        """NumPy conversion protocol: one bulk device fetch.  Without
+        this, np.asarray falls back to elementwise ``__getitem__`` --
+        N separate device gathers, each a full round-trip on a remote
+        device."""
+        a = self.asnumpy()
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a
+
     def asscalar(self):
         if self.size != 1:
             raise MXNetError("asscalar: array is not scalar-sized")

@@ -15,6 +15,14 @@ if "host_platform_device_count" not in prev:
         prev + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# The env var alone does not pin the backend on hosts where a TPU
+# plugin's sitecustomize imported jax before pytest (the tunneled TPU
+# stays the default device, and any unplaced array silently routes
+# through it).  The config update pins the suite to CPU for real.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

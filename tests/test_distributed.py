@@ -14,6 +14,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = r"""
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the env var alone is NOT enough here: the axon TPU plugin's
+# sitecustomize imports jax before this script runs, so the tunneled
+# TPU stays the default backend and any unplaced array drags these
+# "cpu" workers through the (shared, contended) tunnel -- the config
+# update pins the backend for real
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import mxnet_tpu as mx
 
@@ -55,6 +62,101 @@ print("WORKER_OK rank=%d" % kv.rank)
 """
 
 
+_DEEP_WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")   # see _WORKER's comment
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+assert mx.distributed_init() is True
+N = 3
+
+# --- dist_async: server-side optimizer, replicated updates ----------
+# (async = async DISPATCH in this design: same converged weights as
+# dist_sync, no staleness; see kvstore.py module docstring)
+kv = mx.kv.create("dist_async")
+assert kv.num_workers == N
+rank = kv.rank
+kv.init("w", mx.nd.zeros((4,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+expected = np.zeros(4, np.float32)
+for it in range(2):
+    g = mx.nd.ones((4,)) * (rank + 1)
+    kv.push("w", g)                       # allreduce-sum: 1+2+3 = 6
+    expected -= 0.1 * 6.0
+out = mx.nd.zeros((4,))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-5)
+
+# --- bigarray: a ~2 MB value through the dist pushpull path ----------
+# (reference shards big arrays across servers at BIGARRAY_BOUND; the
+# serverless allreduce has no shard split, but the transport must
+# carry server-scale values correctly)
+big = np.arange(512 * 1024, dtype=np.float32) / 1e6
+bout = mx.nd.zeros((512 * 1024,))
+kv2 = mx.kv.create("dist_sync")
+kv2.init("big", mx.nd.zeros((512 * 1024,)))
+kv2.pushpull("big", mx.nd.array(big), out=bout)
+np.testing.assert_allclose(bout.asnumpy(), big * N, rtol=1e-6)
+
+# --- row_sparse over dist: row-union merge, then dist reduce ---------
+kv3 = mx.kv.create("dist_sync")
+kv3.init("emb", mx.nd.zeros((6, 2)))
+rows = np.array([rank, rank + 1], np.int64)
+vals = np.full((2, 2), float(rank + 1), np.float32)
+g = sp.RowSparseNDArray(vals, rows, (6, 2))
+rout = mx.nd.zeros((6, 2))
+kv3.pushpull("emb", g, out=rout)
+dense = np.zeros((6, 2), np.float32)
+for r in range(N):
+    dense[r] += r + 1
+    dense[r + 1] += r + 1
+np.testing.assert_allclose(rout.asnumpy(), dense, rtol=1e-6)
+
+# row_sparse_pull moves only the requested rows of the stored table
+kv3.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+kv3.push("emb", g)                        # emb <- -1.0 * dense
+picked = kv3.row_sparse_pull("emb", row_ids=mx.nd.array([1, 2]))
+assert isinstance(picked, sp.RowSparseNDArray)
+np.testing.assert_allclose(np.asarray(picked.indices), [1, 2])
+np.testing.assert_allclose(np.asarray(picked.data), -dense[1:3],
+                           rtol=1e-6)
+
+# --- 2-bit compression with error feedback over the dist path --------
+kv4 = mx.kv.create("dist_sync")
+kv4.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv4.init("c", mx.nd.zeros((3,)))
+cout = mx.nd.zeros((3,))
+# round 1: |0.3| < threshold -> every worker sends 0, residual keeps 0.3
+kv4.pushpull("c", mx.nd.ones((3,)) * 0.3, out=cout)
+np.testing.assert_allclose(cout.asnumpy(), np.zeros(3), atol=1e-7)
+# round 2: residual 0.3 + 0.3 = 0.6 >= threshold -> each sends 0.5
+kv4.pushpull("c", mx.nd.ones((3,)) * 0.3, out=cout)
+np.testing.assert_allclose(cout.asnumpy(), np.full(3, 0.5 * N),
+                           rtol=1e-6)
+
+kv.barrier()
+print("DEEP_WORKER_OK rank=%d" % rank)
+"""
+
+
+def _launch(script_path, n, env):
+    # coordinator startup can race the free-port probe on a busy
+    # machine; retry once before calling it a failure
+    out = None
+    for attempt in range(2):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+             "-n", str(n), sys.executable, "-u", str(script_path)],
+            capture_output=True, text=True, timeout=300, env=env)
+        if out.returncode == 0:
+            break
+    return out
+
+
 @pytest.mark.skipif(os.environ.get("MXNET_TPU_SKIP_DIST") == "1",
                     reason="dist tests disabled")
 def test_two_process_dist_kvstore(tmp_path):
@@ -62,17 +164,25 @@ def test_two_process_dist_kvstore(tmp_path):
     script.write_text(_WORKER)
     env = {**os.environ, "PYTHONPATH": REPO + os.pathsep +
            os.environ.get("PYTHONPATH", "")}
-    # coordinator startup can race the free-port probe on a busy
-    # machine; retry once before calling it a failure
-    for attempt in range(2):
-        out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-             "-n", "2", sys.executable, "-u", str(script)],
-            capture_output=True, text=True, timeout=300, env=env)
-        if out.returncode == 0:
-            break
+    out = _launch(script, 2, env)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert out.stdout.count("WORKER_OK") == 2
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TPU_SKIP_DIST") == "1",
+                    reason="dist tests disabled")
+def test_three_process_dist_kvstore_deep(tmp_path):
+    """3-process run covering dist_async updates, a ~2 MB bigarray
+    value, row_sparse push + row_sparse_pull, and 2-bit compression
+    with error feedback -- all over the real launcher + jax.distributed
+    (reference: ``tests/nightly/dist_sync_kvstore.py``)."""
+    script = tmp_path / "deep_worker.py"
+    script.write_text(_DEEP_WORKER)
+    env = {**os.environ, "PYTHONPATH": REPO + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    out = _launch(script, 3, env)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert out.stdout.count("DEEP_WORKER_OK") == 3
 
 
 def test_horovod_single_process_api():
