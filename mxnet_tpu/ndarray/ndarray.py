@@ -170,6 +170,10 @@ class NDArray:
         this, np.asarray falls back to elementwise ``__getitem__`` --
         N separate device gathers, each a full round-trip on a remote
         device."""
+        if copy is False:
+            raise ValueError(
+                "converting an NDArray to numpy always copies from the "
+                "device buffer; copy=False cannot be satisfied")
         a = self.asnumpy()
         if dtype is not None:
             a = a.astype(dtype, copy=False)

@@ -18,10 +18,14 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # The env var alone does not pin the backend on hosts where a TPU
 # plugin's sitecustomize imported jax before pytest (the tunneled TPU
 # stays the default device, and any unplaced array silently routes
-# through it).  The config update pins the suite to CPU for real.
+# through it) -- and on such hosts JAX_PLATFORMS itself is forced by
+# the environment, so it can't express the user's intent either.  Pin
+# the suite to its CPU contract; a deliberate on-device run says so
+# explicitly via MXNET_TPU_TEST_PLATFORM.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_platforms",
+                  os.environ.get("MXNET_TPU_TEST_PLATFORM", "cpu"))
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
