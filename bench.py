@@ -136,11 +136,14 @@ def _bench_train(net, loss_fn, data_shape, label_shape, n_classes,
                             {"learning_rate": lr, "momentum": 0.9},
                             kvstore=None)
     step = TrainStep(net, loss_fn, trainer, mesh=None)
-    rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(*data_shape).astype(np.float32), ctx=ctx)
-    y = mx.nd.array(
-        rng.randint(0, n_classes, size=label_shape).astype(np.float32),
-        ctx=ctx)
+    # synthetic inputs are GENERATED ON-DEVICE (mx.nd.random is
+    # jax.random-backed): a host randn + device_put would stage the
+    # whole tensor through the tunnel, whose H2D throughput swings by
+    # orders of magnitude (env_health line) and has nothing to do with
+    # training throughput
+    x = mx.nd.random.normal(shape=data_shape, ctx=ctx)
+    y = mx.nd.random.randint(0, n_classes, shape=label_shape,
+                             ctx=ctx).astype("float32")
     with amp_ctx:
         for _ in range(warmup):
             step(x, y)
@@ -272,11 +275,11 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
                             kvstore=None)
     step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer,
                      mesh=None)
-    rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(k, batch_size, 3, 224, 224).astype(np.float32),
-                    ctx=ctx)
-    y = mx.nd.array(rng.randint(0, 1000, (k, batch_size)).astype(np.float32),
-                    ctx=ctx)
+    # on-device synthetic data: staging (k, 256, 3, 224, 224) fp32
+    # through a degraded tunnel can cost minutes and measures nothing
+    x = mx.nd.random.normal(shape=(k, batch_size, 3, 224, 224), ctx=ctx)
+    y = mx.nd.random.randint(0, 1000, shape=(k, batch_size),
+                             ctx=ctx).astype("float32")
     amp_ctx = amp.scope(dtype) if dtype != "float32" \
         else contextlib.nullcontext()
     with amp_ctx:
@@ -289,9 +292,9 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
             float(out.asnumpy()[-1])
             wins.append(batch_size * k / (time.perf_counter() - t0))
         # single-step program for an honest per-step flop count (the scan
-        # program reports its loop body once)
-        step(mx.nd.array(x.asnumpy()[0], ctx=ctx),
-             mx.nd.array(y.asnumpy()[0], ctx=ctx))
+        # program reports its loop body once); slice ON DEVICE -- an
+        # asnumpy here would fetch the whole (k, B, ...) tensor
+        step(x[0], y[0])
         ca = step.cost_analysis()
     med = statistics.median(wins)
     dt = batch_size / med
@@ -330,13 +333,11 @@ def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
     trainer = gluon.Trainer(net.collect_params(), "adam",
                             {"learning_rate": 1e-4}, kvstore=None)
     step = TrainStep(net, MLMLoss(), trainer, mesh=None)
-    rng = np.random.RandomState(0)
-    ids = mx.nd.array(
-        rng.randint(0, vocab, (batch_size, seq_len)).astype(np.float32),
-        ctx=ctx)
-    labels = mx.nd.array(
-        rng.randint(0, vocab, (batch_size, seq_len)).astype(np.float32),
-        ctx=ctx)
+    # on-device synthetic tokens (see bench_resnet50_scan's comment)
+    ids = mx.nd.random.randint(0, vocab, shape=(batch_size, seq_len),
+                               ctx=ctx).astype("float32")
+    labels = mx.nd.random.randint(0, vocab, shape=(batch_size, seq_len),
+                                  ctx=ctx).astype("float32")
     amp_ctx = amp.scope(dtype) if dtype != "float32" \
         else contextlib.nullcontext()
     with amp_ctx:
