@@ -688,19 +688,19 @@ def main():
                       if headline else None}))
 
     # -- garnish (budget-gated; order = value per second) -------------
-    if _budget_ok("lenet_mnist_train", 90):
+    if _budget_ok("lenet_mnist_train", 120):
         _emit_with_retry("lenet_mnist_train",
                          lambda: bench_lenet(lenet_bs), attempts=1,
                          unit="img/s")
 
-    if _budget_ok("lenet_mnist_train_imperative", 90):
+    if _budget_ok("lenet_mnist_train_imperative", 120):
         _emit_with_retry(
             "lenet_mnist_train_imperative",
             lambda: bench_lenet_imperative(lenet_bs,
                                            iters=30 if on_tpu else 5),
             attempts=1, unit="img/s")
 
-    if on_tpu and _budget_ok("lenet_imperative_local_dispatch_cpu", 150):
+    if on_tpu and _budget_ok("lenet_imperative_local_dispatch_cpu", 180):
         # Evidence for the dispatch-gap claim: the same imperative loop
         # with LOCAL dispatch (CPU backend, no tunnel RTT per op).  Run in
         # subprocesses so the CPU backend can't disturb this process.
@@ -719,12 +719,12 @@ def main():
             print(json.dumps({"metric": "lenet_imperative_local_dispatch",
                               "error": str(e)[:200]}))
 
-    if _budget_ok("resnet50_imagenet_train_fp32", 120):
+    if _budget_ok("resnet50_imagenet_train_fp32", 180):
         _emit_with_retry("resnet50_imagenet_train_fp32",
                          lambda: bench_resnet50(rn_bs), attempts=1,
                          unit="img/s")
 
-    if _budget_ok("pipeline", 180):
+    if _budget_ok("pipeline", 240):
         try:
             jpeg_ips, raw_ips, scaling = bench_pipeline(
                 n=512 if on_tpu else 128, threads=2)
@@ -742,7 +742,7 @@ def main():
         except Exception as e:
             print(json.dumps({"metric": "pipeline", "error": str(e)[:200]}))
 
-    if on_tpu and _budget_ok("resnet50_imagenet_train_e2e_bf16", 420):
+    if on_tpu and _budget_ok("resnet50_imagenet_train_e2e_bf16", 600):
         try:
             # fresh subprocess: the dataset staging transfer must happen
             # before any compute touches this process's tunnel
@@ -762,10 +762,10 @@ def main():
         # seq sweep: captures the XLA/Pallas crossover in the artifact
         # (auto path: seq 128 -> plain XLA attention, seq >= 256 ->
         # Pallas flash kernels)
-        if _budget_ok("bert_base_pretrain_seq512_bf16", 150):
+        if _budget_ok("bert_base_pretrain_seq512_bf16", 300):
             _emit_bert("bert_base_pretrain_seq512_bf16", 64, 512,
                        "bfloat16", 10, attempts=1)
-        if _budget_ok("bert_base_pretrain_seq1024_bf16_flash", 150):
+        if _budget_ok("bert_base_pretrain_seq1024_bf16_flash", 600):
             # long-context config: seq 1024 is where the Pallas flash
             # fwd+bwd kernels pull away from XLA (81k vs 60k tok/s, r3)
             _emit_bert("bert_base_pretrain_seq1024_bf16_flash", 16,

@@ -88,6 +88,12 @@ _VARS = [
            "pending region as ONE jitted program at the next sync point "
            "(the reference's MXNET_EXEC_BULK_EXEC_TRAIN analog).  '0' "
            "dispatches each eager op individually."),
+    EnvVar("MXNET_TPU_TEST_PLATFORM", str, "cpu",
+           "Backend the test suite pins via jax.config (tests/"
+           "conftest.py).  The suite's contract is 8 virtual CPU "
+           "devices; set e.g. 'tpu' for a deliberate on-device run.  "
+           "A dedicated var because JAX_PLATFORMS itself is forced by "
+           "some environments and cannot carry user intent."),
     EnvVar("MXNET_TPU_BENCH_BUDGET_S", float, 1500.0,
            "Wall-clock budget (seconds) for bench.py: headline metrics "
            "emit first, and optional configs that would exceed the "

@@ -3,14 +3,16 @@ died at rc=124 with the headline lines unprinted; this locks the
 headline-first emission order and the self-budget so that regression
 class cannot ship silently)."""
 import json
-import sys
+import os
 
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture()
 def bench_mod(monkeypatch):
-    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    monkeypatch.syspath_prepend(REPO)   # cleaned up at teardown
     import bench
     # stub every device-touching benchmark
     monkeypatch.setattr(bench, "bench_env_health",
