@@ -63,7 +63,37 @@ class Trainer:
             self._kvstore = spec
         if self._kvstore is not None and self._compression_params:
             self._kvstore.set_gradient_compression(self._compression_params)
+        self._dist_synced = set()
+        self._sync_initial_params()
         self._kv_initialized = True
+
+    def _sync_initial_params(self):
+        """Reference semantics (kvstore_dist.h :: Init + Pull): rank
+        0's initial weights are pushed to the servers and every worker
+        pulls them back, so all ranks START identical even though each
+        process's initializer drew from its own entropy.  Serverless
+        analog: broadcast from rank 0.  Runs per step so params whose
+        deferred init materializes LATER still get synced exactly once
+        (the reference inits kvstore keys lazily per-param too)."""
+        if self._kvstore is None or \
+                not getattr(self._kvstore, "_is_dist", False):
+            return
+        from ..distributed import host_broadcast, world
+        if world()[0] <= 1:
+            return
+        import jax
+        for p in self._params:
+            if p.name in self._dist_synced or p._data is None:
+                continue
+            val = p._data._data
+            out = host_broadcast(val, root=0)
+            if isinstance(val, jax.Array):
+                # preserve the param's sharding: host_broadcast lands
+                # on a single device, which would silently reshard a
+                # mesh-replicated parameter
+                out = jax.device_put(out, val.sharding)
+            p._data._data = out
+            self._dist_synced.add(p.name)
 
     def _check_and_rescale_grad(self, scale):
         self._optimizer.rescale_grad = scale
@@ -102,6 +132,7 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        self._sync_initial_params()   # late deferred-init params
         for i, p in enumerate(self._params):
             if p.grad_req != "null" and p._data is not None \
                     and p._data._grad is not None:

@@ -143,6 +143,57 @@ print("DEEP_WORKER_OK rank=%d" % rank)
 """
 
 
+_TRAINER_WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")   # see _WORKER's comment
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+assert mx.distributed_init() is True
+from mxnet_tpu.distributed import world
+nproc, rank = world()
+assert nproc == 2
+
+# the standard distributed UX: gluon Trainer over a dist_sync kvstore,
+# each rank feeding DIFFERENT data; gradients allreduce before the
+# update so every rank must end with IDENTICAL weights
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(1))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd",
+                   {"learning_rate": 0.05}, kvstore="dist_sync")
+loss_fn = gluon.loss.L2Loss()
+rng = np.random.RandomState(100 + rank)      # per-rank data
+w = np.random.RandomState(0).randn(5, 1).astype(np.float32)  # shared
+xn = rng.randn(32, 5).astype(np.float32)
+x = mx.nd.array(xn)
+y = mx.nd.array(xn @ w)
+first = last = None
+for i in range(40):
+    with autograd.record():
+        l = loss_fn(net(x), y).mean()
+    l.backward()
+    tr.step(1)
+    v = float(l.asnumpy())
+    first = v if first is None else first
+    last = v
+assert last < first / 2, (first, last)
+
+# weights identical across ranks: hash-reduce must equal 2x the local
+from mxnet_tpu.distributed import host_allreduce
+for name, p in sorted(net.collect_params().items()):
+    local = np.asarray(p.data().asnumpy(), np.float64)
+    summed = np.asarray(host_allreduce(local))
+    np.testing.assert_allclose(summed, 2.0 * local, rtol=1e-6,
+                               err_msg=name)
+print("TRAINER_WORKER_OK rank=%d loss %.4f -> %.4f" % (rank, first, last))
+"""
+
+
 def _launch(script_path, n, env):
     # coordinator startup can race the free-port probe on a busy
     # machine; retry once before calling it a failure
@@ -183,6 +234,22 @@ def test_three_process_dist_kvstore_deep(tmp_path):
     out = _launch(script, 3, env)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert out.stdout.count("DEEP_WORKER_OK") == 3
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TPU_SKIP_DIST") == "1",
+                    reason="dist tests disabled")
+def test_two_process_gluon_trainer_dist_sync(tmp_path):
+    """End-to-end distributed TRAINING through the standard UX:
+    gluon.Trainer(kvstore='dist_sync'), per-rank data, replicated
+    post-update weights (reference: the dist kvstore training loop in
+    example/image-classification/common/fit.py)."""
+    script = tmp_path / "trainer_worker.py"
+    script.write_text(_TRAINER_WORKER)
+    env = {**os.environ, "PYTHONPATH": REPO + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    out = _launch(script, 2, env)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert out.stdout.count("TRAINER_WORKER_OK") == 2
 
 
 def test_horovod_single_process_api():
