@@ -135,21 +135,25 @@ def _warn_kv_fallback():
 
 
 def _result_device(arr):
-    """Device the collective's result should land on: the INPUT's
-    device when it is a jax array.  ``jnp.asarray`` would place the
-    result on the DEFAULT device instead -- on this environment that is
-    a remote tunneled TPU even under JAX_PLATFORMS=cpu, so an
-    unplaced result drags every later use through the tunnel."""
+    """Placement the collective's result should land on: the INPUT's
+    sharding when it is a jax array (a Sharding is a valid device_put
+    target, so mesh-sharded/replicated inputs come back with their
+    layout instead of collapsing onto one device).  ``jnp.asarray``
+    would place the result on the DEFAULT device instead -- on this
+    environment that is a remote tunneled TPU even under
+    JAX_PLATFORMS=cpu, so an unplaced result drags every later use
+    through the tunnel."""
     import jax
     if isinstance(arr, jax.Array):
-        return next(iter(arr.devices()))
+        return arr.sharding
     return None
 
 
-def _place(x, dev):
+def _place(x, placement):
     import jax
     import jax.numpy as jnp
-    return jax.device_put(x, dev) if dev is not None else jnp.asarray(x)
+    return jax.device_put(x, placement) if placement is not None \
+        else jnp.asarray(x)
 
 
 def host_allreduce(arr, average=False, timeout_ms=60000):

@@ -74,25 +74,26 @@ class Trainer:
         process's initializer drew from its own entropy.  Serverless
         analog: broadcast from rank 0.  Runs per step so params whose
         deferred init materializes LATER still get synced exactly once
-        (the reference inits kvstore keys lazily per-param too)."""
+        (the reference inits kvstore keys lazily per-param too).
+
+        SPMD assumption (same as the reference's lazy kv.init, which is
+        also a collective): deferred params must materialize at the
+        SAME step on every rank -- host_broadcast is a world
+        collective, so asymmetric materialization would desequence the
+        collectives."""
         if self._kvstore is None or \
                 not getattr(self._kvstore, "_is_dist", False):
             return
         from ..distributed import host_broadcast, world
         if world()[0] <= 1:
             return
-        import jax
         for p in self._params:
             if p.name in self._dist_synced or p._data is None:
                 continue
-            val = p._data._data
-            out = host_broadcast(val, root=0)
-            if isinstance(val, jax.Array):
-                # preserve the param's sharding: host_broadcast lands
-                # on a single device, which would silently reshard a
-                # mesh-replicated parameter
-                out = jax.device_put(out, val.sharding)
-            p._data._data = out
+            # host_broadcast lands the result back on the input's own
+            # sharding (distributed._result_device), so mesh-sharded
+            # params keep their layout
+            p._data._data = host_broadcast(p._data._data, root=0)
             self._dist_synced.add(p.name)
 
     def _check_and_rescale_grad(self, scale):

@@ -31,6 +31,21 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def paired_params(a, b):
+    """Structurally-paired parameters of two same-architecture blocks.
+
+    The obvious ``zip(sorted(a.collect_params().items()), ...)`` idiom
+    is order-fragile: gluon's auto-name counter is process-global, and
+    once it passes 9, ``dense10_weight`` sorts BEFORE ``dense9_weight``
+    -- so whether the pairing is correct depends on how many blocks
+    earlier tests created.  Structural prefixes are position-stable.
+    """
+    pa = a._collect_params_with_prefix()
+    pb = b._collect_params_with_prefix()
+    assert set(pa) == set(pb)
+    return [(pa[k], pb[k]) for k in sorted(pa)]
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Per-test deterministic seeding (reference:

@@ -111,8 +111,8 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
     net2(x)  # materialize
     meta = mx.preemption.resume(prefix, net2, tr2)
     assert meta["extra"]["step"] == 5
-    for (_, p1), (_, p2) in zip(sorted(net.collect_params().items()),
-                                sorted(net2.collect_params().items())):
+    from conftest import paired_params
+    for p1, p2 in paired_params(net, net2):
         np.testing.assert_array_equal(p1.data().asnumpy(),
                                       p2.data().asnumpy())
     # trained nets continue identically after resume -> states match
@@ -121,8 +121,7 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
             l = loss_fn(n(x), y).mean()
         l.backward()
         t.step(1)
-    for (_, p1), (_, p2) in zip(sorted(net.collect_params().items()),
-                                sorted(net2.collect_params().items())):
+    for p1, p2 in paired_params(net, net2):
         np.testing.assert_allclose(p1.data().asnumpy(),
                                    p2.data().asnumpy(), rtol=1e-6)
 

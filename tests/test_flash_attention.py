@@ -178,8 +178,8 @@ def test_mha_masked_uses_flash_path():
     att_drop = MultiHeadAttention(32, HEADS, dropout=0.5, use_flash=False)
     att_drop.initialize(ctx=mx.cpu())
     # same weights; dropout path only activates in training mode
-    for (_, p1), (_, p2) in zip(sorted(att_flash.collect_params().items()),
-                                sorted(att_drop.collect_params().items())):
+    from conftest import paired_params
+    for p1, p2 in paired_params(att_flash, att_drop):
         p2.set_data(p1.data())
     out2 = att_drop(x, mask).asnumpy()
     np.testing.assert_allclose(out1, out2, rtol=2e-4, atol=2e-5)

@@ -96,14 +96,13 @@ def test_hybrid_training_gradients():
     net2.add(nn.Dense(16, activation="relu"), nn.Dense(4))
     net2.initialize()
     # copy params
-    for (n1, p1), (n2, p2) in zip(sorted(net.collect_params().items()),
-                                  sorted(net2.collect_params().items())):
+    from conftest import paired_params
+    for p1, p2 in paired_params(net, net2):
         p2.set_data(p1.data())
     with autograd.record():
         loss2 = net2(x).sum()
     loss2.backward()
-    for (n1, p1), (n2, p2) in zip(sorted(net.collect_params().items()),
-                                  sorted(net2.collect_params().items())):
+    for p1, p2 in paired_params(net, net2):
         np.testing.assert_allclose(p2.data()._grad.asnumpy(),
                                    p1.data()._grad.asnumpy(),
                                    rtol=5e-3, atol=1e-5)

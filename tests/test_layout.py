@@ -44,12 +44,15 @@ def test_nhwc_matches_nchw():
     b.hybridize()
     xb = mx.nd.array(np.transpose(x, (0, 2, 3, 1)))
     b(xb)  # materialize deferred shapes
-    for (na, pa), (_, pb) in zip(sorted(a.collect_params().items()),
-                                 sorted(b.collect_params().items())):
+    pa_map = a._collect_params_with_prefix()
+    pb_map = b._collect_params_with_prefix()
+    assert set(pa_map) == set(pb_map)
+    for key in sorted(pa_map):
+        pa, pb = pa_map[key], pb_map[key]
         w = pa.data().asnumpy()
         # conv weights go OIHW -> OHWI (shape compare alone is ambiguous
         # when I == kh == kw)
-        if w.ndim == 4 and "conv" in na:
+        if w.ndim == 4 and "conv" in pa.name:
             w = np.transpose(w, (0, 2, 3, 1))
         assert pb.shape == w.shape
         pb.set_data(mx.nd.array(w))

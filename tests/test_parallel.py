@@ -312,16 +312,15 @@ def test_run_steps_matches_sequential():
     net_b, step_b = mknet()
     net_a(mx.nd.array(x[0]))
     net_b(mx.nd.array(x[0]))
-    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
-                                sorted(net_b.collect_params().items())):
+    from conftest import paired_params
+    for pa, pb in paired_params(net_a, net_b):
         pb.set_data(mx.nd.array(pa.data().asnumpy()))
 
     ref = [float(step_a(mx.nd.array(x[i]), mx.nd.array(y[i])).asscalar())
            for i in range(3)]
     losses = step_b.run_steps(mx.nd.array(x), mx.nd.array(y)).asnumpy()
     np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
-    for (_, pa), (_, pb) in zip(sorted(net_a.collect_params().items()),
-                                sorted(net_b.collect_params().items())):
+    for pa, pb in paired_params(net_a, net_b):
         np.testing.assert_allclose(pa.data().asnumpy(),
                                    pb.data().asnumpy(), rtol=2e-4,
                                    atol=1e-5)
