@@ -235,16 +235,19 @@ class KVStore:
             raise MXNetError("kvstore key %r not initialized" % key)
         if row_ids is None:
             return self.pull(key, out, priority)
-        from .distributed import _place, _result_device
+        from .distributed import _place
         rows = row_ids._data if isinstance(row_ids, NDArray) else row_ids
         full = self._store[key]._data
         # dedup host-side (reference PullRowSparse dedups): duplicate ids
         # would double rows under the sparse todense() scatter-add.
         # Place the ids WITH the table: an unplaced jnp.asarray would
         # put them on the DEFAULT device (a remote TPU here), dragging
-        # the gather through the tunnel per pull
-        rows = _place(np.unique(np.asarray(rows).astype(np.int32)),
-                      _result_device(full))
+        # the gather through the tunnel per pull.  A DEVICE target, not
+        # the table's sharding -- the 1-D id vector can't take a
+        # dim-partitioned rank-2 sharding.
+        dev = next(iter(full.devices())) \
+            if isinstance(full, jax.Array) else None
+        rows = _place(np.unique(np.asarray(rows).astype(np.int32)), dev)
         picked_rows = full[rows]                      # (k, ...) gather only
         if out is None:
             return _sp.RowSparseNDArray(picked_rows, rows,

@@ -7,12 +7,12 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=420):
+def _run(args, timeout=420, env_extra=None):
     return subprocess.run(
         [sys.executable] + args, capture_output=True, text=True,
         timeout=timeout,
         env={**os.environ, "PYTHONPATH": REPO + os.pathsep +
-             os.environ.get("PYTHONPATH", "")})
+             os.environ.get("PYTHONPATH", ""), **(env_extra or {})})
 
 
 def test_module_mnist_example():
@@ -42,3 +42,13 @@ def test_gluon_mnist_example():
                 "--max-batches", "20"], timeout=540)
     assert out.returncode == 0, out.stderr[-1500:]
     assert "accuracy=" in out.stdout
+
+
+def test_dist_sync_train_example():
+    out = _run([os.path.join(REPO, "tools", "launch.py"), "-n", "2",
+                sys.executable, "-u",
+                os.path.join(REPO, "examples", "dist_sync_train.py"),
+                "--epochs", "2", "--samples", "128"],
+               env_extra={"JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-1500:])
+    assert out.stdout.count("TRAINED OK") == 2

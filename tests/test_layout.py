@@ -44,11 +44,8 @@ def test_nhwc_matches_nchw():
     b.hybridize()
     xb = mx.nd.array(np.transpose(x, (0, 2, 3, 1)))
     b(xb)  # materialize deferred shapes
-    pa_map = a._collect_params_with_prefix()
-    pb_map = b._collect_params_with_prefix()
-    assert set(pa_map) == set(pb_map)
-    for key in sorted(pa_map):
-        pa, pb = pa_map[key], pb_map[key]
+    from conftest import paired_params
+    for pa, pb in paired_params(a, b):
         w = pa.data().asnumpy()
         # conv weights go OIHW -> OHWI (shape compare alone is ambiguous
         # when I == kh == kw)
