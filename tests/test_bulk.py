@@ -141,3 +141,36 @@ def test_bulk_cache_bounded():
     for i in range(bulk._CACHE_MAX + 10):
         bulk._cache_put(d, ("k", i), i)
     assert len(d) <= bulk._CACHE_MAX
+
+
+def test_bulked_cotangents_through_control_flow():
+    """Advisor r4 (high): backward through a lax.scan-based construct
+    (contrib.foreach) receives cotangents that may be pending
+    bulk.LazyData from the bulked backward of downstream eager ops; the
+    raw jax.vjp pull must materialize them.  The crash was latent --
+    warmup returns concrete outputs -- so the SECOND and THIRD
+    iterations with a matching signature are the actual test."""
+    _bulk_or_skip()
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import contrib as ndc
+
+    for rep in range(3):
+        data = mx.nd.array(
+            np.arange(20, dtype=np.float32).reshape(5, 4) + rep)
+        s0 = mx.nd.zeros((4,))
+        data.attach_grad()
+        with autograd.record():
+            outs, fin = ndc.foreach(
+                lambda d, s: (d * 2 + s, s + d), data, s0)
+            # downstream EAGER ops: their backward enqueues into the
+            # bulk queue, producing LazyData cotangents for foreach
+            tot = (outs * 3.0).sum() + (fin * 2.0).sum()
+        tot.backward()
+        g = data.grad.asnumpy()
+        assert np.isfinite(g).all()
+    # gradient value check (last rep): d tot / d data[t] =
+    # 3*2 (direct) + 3*(rows below, via state) + 2 (fin) per element
+    rows_below = np.arange(4, -1, -1)[:, None]  # t contributes to t+1..4
+    expect = 6.0 + 3.0 * (rows_below - 0) + 2.0
+    expect = np.broadcast_to(expect, (5, 4))
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
