@@ -174,3 +174,39 @@ def test_bulked_cotangents_through_control_flow():
     expect = 6.0 + 3.0 * (rows_below - 0) + 2.0
     expect = np.broadcast_to(expect, (5, 4))
     np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_bulk_with_threaded_dataloader_training():
+    """The realistic combined scenario the bulk lock exists for:
+    DataLoader WORKER THREADS produce batches (touching mx.nd eagerly)
+    while the main thread trains with bulked eager dispatch + autograd
+    -- queue handoff, concurrent enqueue/flush, cotangent bulking all
+    at once."""
+    _bulk_or_skip()
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 6).astype(np.float32)
+    w = rng.randn(6, 1).astype(np.float32)
+    ys = (xs @ w).astype(np.float32)
+    loader = DataLoader(ArrayDataset(xs, ys), batch_size=16,
+                        shuffle=True, num_workers=2)
+
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    loss_fn = gluon.loss.L2Loss()
+    first = last = None
+    for epoch in range(8):
+        for bx, by in loader:
+            with autograd.record():
+                loss = loss_fn(net(bx), by).mean()
+            loss.backward()
+            tr.step(1)
+            v = float(loss.asnumpy())
+            first = v if first is None else first
+            last = v
+    assert np.isfinite(last)
+    assert last < first, (first, last)
