@@ -42,6 +42,21 @@ int MXPredGetOutput(PredictorHandle h, int index, float* out, int64_t size);
 
 void MXPredFree(PredictorHandle h);
 
+/* .params parameter-container reader (reference: c_predict_api.h ::
+ * MXNDListCreate/MXNDListGet/MXNDListFree).  Loads the framework's
+ * .params files with no Python in the loop; stored dtypes (fp32/fp64/
+ * fp16/bf16/int8..int64/uint8) are exposed as float, as upstream does.
+ * Pointers returned by MXNDListGet stay valid until MXNDListFree. */
+typedef void* NDListHandle;
+int MXNDListCreate(const char* nd_file_bytes, int64_t nd_file_size,
+                   NDListHandle* out, int64_t* out_length);
+int MXNDListCreateFromFile(const char* path, NDListHandle* out,
+                           int64_t* out_length);
+int MXNDListGet(NDListHandle h, int64_t index, const char** out_key,
+                const float** out_data, const int64_t** out_shape,
+                int* out_ndim);
+void MXNDListFree(NDListHandle h);
+
 #ifdef __cplusplus
 }
 #endif

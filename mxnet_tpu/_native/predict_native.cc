@@ -845,6 +845,182 @@ struct Predictor {
   }
 };
 
+// ---------------------------------------------------------------------
+// .params container reader (reference: c_predict_api.h :: MXNDListCreate
+// over src/ndarray/ndarray.cc :: NDArray::Load).  Same dependency-free
+// contract as the ONNX runtime: parameter files load with no Python in
+// the loop.  Layout (little-endian; see mxnet_tpu/ndarray/ndarray.py
+// and tests/test_params_format.py, which lock it byte-for-byte):
+//   u64 list magic 0x112 | u64 reserved | u64 count
+//   per array: u32 magic 0xF993FAC9 | i32 stype(0=dense) | u32 ndim |
+//              i64*ndim dims | i32 dev_type + i32 dev_id | i32 dtype
+//              flag | raw element bytes
+//   u64 name count | per name: u64 byte length + utf-8
+// ---------------------------------------------------------------------
+
+struct NDList {
+  std::vector<std::string> names;
+  std::vector<Tensor> arrays;
+};
+
+struct LEReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool take(void* dst, size_t n) {
+    if (!ok || size_t(end - p) < n) {
+      ok = false;
+      return false;
+    }
+    memcpy(dst, p, n);
+    p += n;
+    return true;
+  }
+  uint64_t u64() { uint64_t v = 0; take(&v, 8); return v; }
+  uint32_t u32() { uint32_t v = 0; take(&v, 4); return v; }
+  int32_t i32() { int32_t v = 0; take(&v, 4); return v; }
+  int64_t i64() { int64_t v = 0; take(&v, 8); return v; }
+};
+
+float half_to_float(uint16_t h) {
+  uint32_t sign = uint32_t(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t man = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;                       // +-0
+    } else {                             // subnormal: renormalize
+      uint32_t e = 127 - 15 + 1;
+      while (!(man & 0x400)) { man <<= 1; --e; }
+      bits = sign | (e << 23) | ((man & 0x3FF) << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (man << 13);   // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+template <typename T>
+bool read_as_float(LEReader* r, int64_t n, std::vector<float>* out) {
+  std::vector<T> tmp(static_cast<size_t>(n));
+  if (!r->take(tmp.data(), size_t(n) * sizeof(T))) return false;
+  for (int64_t i = 0; i < n; ++i) (*out)[size_t(i)] = float(tmp[size_t(i)]);
+  return true;
+}
+
+bool parse_params(const uint8_t* data, uint64_t len, NDList* out) {
+  LEReader r{data, data + len};
+  if (r.u64() != 0x112) {
+    g_last_error = "bad .params list magic";
+    return false;
+  }
+  r.u64();  // reserved
+  uint64_t count = r.u64();
+  // each serialized array needs >= 24 bytes of header alone: bounding
+  // count by the remaining bytes stops a tiny crafted file from
+  // forcing a huge up-front allocation
+  if (!r.ok || count > uint64_t(r.end - r.p) / 24) {
+    g_last_error = "corrupt .params header";
+    return false;
+  }
+  out->arrays.resize(size_t(count));
+  for (auto& t : out->arrays) {
+    if (r.u32() != 0xF993FAC9u) {
+      g_last_error = "bad ndarray magic in .params";
+      return false;
+    }
+    if (r.i32() != 0) {
+      g_last_error = ".params: only dense arrays supported";
+      return false;
+    }
+    uint32_t ndim = r.u32();
+    if (!r.ok || ndim > 32) {
+      g_last_error = ".params: corrupt ndarray rank";
+      return false;
+    }
+    t.shape.resize(ndim);
+    // overflow-checked element count: crafted dims like [2^32, 2^32]
+    // would wrap numel() to a small value and desynchronize the size
+    // check from the shape handed to the C caller
+    int64_t n = 1;
+    for (auto& d : t.shape) {
+      d = r.i64();
+      if (!r.ok || d < 0 ||
+          (d != 0 && n > INT64_MAX / (d ? d : 1))) {
+        g_last_error = ".params: corrupt ndarray dims";
+        return false;
+      }
+      n *= d;
+    }
+    r.i32();
+    r.i32();  // dev_type, dev_id
+    int32_t flag = r.i32();
+    if (!r.ok || uint64_t(n) > uint64_t(r.end - r.p)) {
+      g_last_error = ".params: corrupt ndarray size";
+      return false;
+    }
+    t.data.resize(size_t(n));
+    bool good = true;
+    switch (flag) {
+      case 0:   // float32
+        good = r.take(t.data.data(), size_t(n) * 4);
+        break;
+      case 1: good = read_as_float<double>(&r, n, &t.data); break;
+      case 2: {  // float16
+        std::vector<uint16_t> tmp(static_cast<size_t>(n));
+        good = r.take(tmp.data(), size_t(n) * 2);
+        if (good)
+          for (int64_t i = 0; i < n; ++i)
+            t.data[size_t(i)] = half_to_float(tmp[size_t(i)]);
+        break;
+      }
+      case 3: good = read_as_float<uint8_t>(&r, n, &t.data); break;
+      case 4: good = read_as_float<int32_t>(&r, n, &t.data); break;
+      case 5: good = read_as_float<int8_t>(&r, n, &t.data); break;
+      case 6: good = read_as_float<int64_t>(&r, n, &t.data); break;
+      case 100: {  // bfloat16: high 16 bits of a float32
+        std::vector<uint16_t> tmp(static_cast<size_t>(n));
+        good = r.take(tmp.data(), size_t(n) * 2);
+        if (good)
+          for (int64_t i = 0; i < n; ++i) {
+            uint32_t bits = uint32_t(tmp[size_t(i)]) << 16;
+            memcpy(&t.data[size_t(i)], &bits, 4);
+          }
+        break;
+      }
+      default:
+        g_last_error = ".params: unsupported dtype flag";
+        return false;
+    }
+    if (!good) {
+      g_last_error = ".params: truncated tensor data";
+      return false;
+    }
+  }
+  uint64_t nnames = r.u64();
+  if (!r.ok || (nnames != 0 && nnames != count)) {
+    g_last_error = ".params: corrupt name table";
+    return false;
+  }
+  out->names.resize(size_t(nnames));
+  for (auto& s : out->names) {
+    uint64_t ln = r.u64();
+    if (!r.ok || ln > uint64_t(r.end - r.p)) {
+      g_last_error = ".params: corrupt name entry";
+      return false;
+    }
+    s.assign(reinterpret_cast<const char*>(r.p), size_t(ln));
+    r.p += ln;
+  }
+  return r.ok;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------
@@ -951,5 +1127,81 @@ int MXPredGetOutput(PredictorHandle h, int index, float* out,
 }
 
 void MXPredFree(PredictorHandle h) { delete static_cast<Predictor*>(h); }
+
+// -- .params list ABI (reference: c_predict_api.h :: MXNDListCreate /
+// MXNDListGet / MXNDListFree; values are exposed as float like the
+// reference, whatever the stored dtype) ------------------------------
+
+typedef void* NDListHandle;
+
+int MXNDListCreate(const char* nd_file_bytes, int64_t nd_file_size,
+                   NDListHandle* out, int64_t* out_length) {
+  try {
+    auto list = std::make_unique<NDList>();
+    if (!parse_params(reinterpret_cast<const uint8_t*>(nd_file_bytes),
+                      uint64_t(nd_file_size), list.get())) {
+      if (g_last_error.empty()) g_last_error = "malformed .params file";
+      return -1;
+    }
+    if (out_length) *out_length = int64_t(list->arrays.size());
+    *out = list.release();
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXNDListCreateFromFile(const char* path, NDListHandle* out,
+                           int64_t* out_length) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    g_last_error = std::string("cannot open ") + path;
+    return -1;
+  }
+  fseek(f, 0, SEEK_END);
+  long len = ftell(f);
+  if (len < 0) {
+    fclose(f);
+    g_last_error = "cannot determine file size";
+    return -1;
+  }
+  fseek(f, 0, SEEK_SET);
+  try {
+    std::vector<char> buf(static_cast<size_t>(len), 0);
+    size_t got = fread(buf.data(), 1, size_t(len), f);
+    fclose(f);
+    if (got != size_t(len)) {
+      g_last_error = "short read";
+      return -1;
+    }
+    return MXNDListCreate(buf.data(), len, out, out_length);
+  } catch (const std::exception& e) {
+    fclose(f);
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int MXNDListGet(NDListHandle h, int64_t index, const char** out_key,
+                const float** out_data, const int64_t** out_shape,
+                int* out_ndim) {
+  auto* list = static_cast<NDList*>(h);
+  if (index < 0 || size_t(index) >= list->arrays.size()) {
+    g_last_error = "MXNDListGet: index out of range";
+    return -1;
+  }
+  const Tensor& t = list->arrays[size_t(index)];
+  if (out_key)
+    *out_key = size_t(index) < list->names.size()
+                   ? list->names[size_t(index)].c_str()
+                   : "";
+  if (out_data) *out_data = t.data.data();
+  if (out_shape) *out_shape = t.shape.data();
+  if (out_ndim) *out_ndim = int(t.shape.size());
+  return 0;
+}
+
+void MXNDListFree(NDListHandle h) { delete static_cast<NDList*>(h); }
 
 }  // extern "C"

@@ -168,3 +168,87 @@ def test_cpp_example_runs_without_python(tmp_path):
                          capture_output=True, text=True, timeout=120)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "output shape: (1, 10)" in run.stdout, run.stdout
+
+
+def test_ndlist_reads_params_without_python(tmp_path):
+    """The MXNDList* ABI slice (reference: ``c_predict_api.h ::
+    MXNDListCreate``): a C caller loads the framework's .params
+    container -- names, shapes, values across dtypes -- with no Python
+    in the loop (this test only USES ctypes to drive the C ABI)."""
+    import ctypes
+
+    import jax.numpy as jnp
+    from mxnet_tpu._native import load_predict
+    lib = load_predict()
+    if lib is None:
+        pytest.skip("no C++ toolchain")
+
+    rng = np.random.RandomState(0)
+    fixture = {
+        "w": rng.randn(3, 4).astype(np.float32),
+        "idx": np.array([5, 1, 9], np.int32),
+        "bytes": np.arange(6, dtype=np.uint8).reshape(2, 3),
+        "half": np.array([0.5, -2.25, 64.0], np.float16),
+    }
+    path = str(tmp_path / "mixed.params")
+    arrs = {k: mx.nd.array(v, dtype=v.dtype) for k, v in fixture.items()}
+    arrs["bf"] = mx.nd.array(np.array([1.5, -3.0], np.float32)).astype(
+        jnp.bfloat16.dtype)
+    fixture["bf"] = np.array([1.5, -3.0], np.float32)
+    mx.nd.save(path, arrs)
+
+    lib.MXNDListCreateFromFile.restype = ctypes.c_int
+    lib.MXNDListGet.restype = ctypes.c_int
+    lib.MXPredGetLastError.restype = ctypes.c_char_p
+    h = ctypes.c_void_p()
+    count = ctypes.c_int64()
+    rc = lib.MXNDListCreateFromFile(path.encode(), ctypes.byref(h),
+                                    ctypes.byref(count))
+    assert rc == 0, lib.MXPredGetLastError().decode()
+    assert count.value == len(fixture)
+    seen = {}
+    for i in range(count.value):
+        key = ctypes.c_char_p()
+        data = ctypes.POINTER(ctypes.c_float)()
+        shape = ctypes.POINTER(ctypes.c_int64)()
+        ndim = ctypes.c_int()
+        rc = lib.MXNDListGet(h, ctypes.c_int64(i), ctypes.byref(key),
+                             ctypes.byref(data), ctypes.byref(shape),
+                             ctypes.byref(ndim))
+        assert rc == 0, lib.MXPredGetLastError().decode()
+        shp = tuple(shape[d] for d in range(ndim.value))
+        n = int(np.prod(shp)) if shp else 1
+        vals = np.array([data[j] for j in range(n)],
+                        np.float32).reshape(shp)
+        seen[key.value.decode()] = vals
+    lib.MXNDListFree(h)
+
+    assert set(seen) == set(fixture)
+    for k, v in fixture.items():
+        np.testing.assert_allclose(seen[k], v.astype(np.float32),
+                                   rtol=1e-3, err_msg=k)
+
+    # corrupt input must error cleanly, not crash
+    import struct
+
+    def expect_reject(name, payload, needle):
+        p = str(tmp_path / name)
+        open(p, "wb").write(payload)
+        rc = lib.MXNDListCreateFromFile(p.encode(), ctypes.byref(h),
+                                        ctypes.byref(count))
+        assert rc != 0, name
+        assert needle in lib.MXPredGetLastError(), (
+            name, lib.MXPredGetLastError())
+
+    expect_reject("bad.params", b"\x00" * 16, b"magic")
+    # a tiny file claiming 2^24 arrays must not allocate for them
+    expect_reject("bigcount.params",
+                  struct.pack("<QQQ", 0x112, 0, 1 << 24), b"header")
+    # dims whose product overflows int64 must be rejected, not wrapped
+    expect_reject(
+        "dimflow.params",
+        struct.pack("<QQQ", 0x112, 0, 1)
+        + struct.pack("<IiI", 0xF993FAC9, 0, 2)
+        + struct.pack("<qq", 1 << 32, 1 << 32)
+        + struct.pack("<iii", 1, 0, 0) + b"\x00" * 64,
+        b"dims")
