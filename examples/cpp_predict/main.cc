@@ -8,7 +8,10 @@
 //   g++ -O2 -std=c++17 main.cc -o cpp_predict -L. -lmxtpu_predict \
 //       -Wl,-rpath,'$ORIGIN'
 // Run:
-//   ./cpp_predict model.onnx N C H W
+//   ./cpp_predict model.onnx N C H W [weights.params]
+// With the optional .params argument, the parameter container is also
+// loaded through the MXNDList* ABI and summarized -- the full
+// model+weights artifact pair, no Python anywhere.
 #include <cstdio>
 #include <cstdlib>
 #include <vector>
@@ -61,5 +64,27 @@ int main(int argc, char** argv) {
     printf(" %.6f", out[size_t(i)]);
   printf("\n");
   MXPredFree(h);
+
+  if (argc > 6) {  // optional: read the .params container too
+    NDListHandle nd;
+    int64_t count;
+    if (MXNDListCreateFromFile(argv[6], &nd, &count) != 0) {
+      fprintf(stderr, "params load failed: %s\n", MXPredGetLastError());
+      return 1;
+    }
+    printf("params: %lld arrays\n", (long long)count);
+    for (int64_t i = 0; i < count && i < 4; ++i) {
+      const char* key;
+      const float* data;
+      const int64_t* shp;
+      int nd_rank;
+      if (MXNDListGet(nd, i, &key, &data, &shp, &nd_rank) != 0) continue;
+      int64_t pn = 1;
+      for (int d = 0; d < nd_rank; ++d) pn *= shp[d];
+      printf("  %s rank=%d first=%.6f\n", key, nd_rank,
+             pn > 0 ? data[0] : 0.f);
+    }
+    MXNDListFree(nd);
+  }
   return 0;
 }

@@ -164,10 +164,16 @@ def test_cpp_example_runs_without_python(tmp_path):
          "-Wl,-rpath," + os.path.dirname(so)],
         capture_output=True, text=True, timeout=180)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    run = subprocess.run([exe, onnx_file, "1", "1", "28", "28"],
+    params_file = str(tmp_path / "weights.params")
+    mx.nd.save(params_file,
+               {"w": mx.nd.array(np.ones((2, 2), np.float32) * 7)})
+    run = subprocess.run([exe, onnx_file, "1", "1", "28", "28",
+                          params_file],
                          capture_output=True, text=True, timeout=120)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "output shape: (1, 10)" in run.stdout, run.stdout
+    assert "params: 1 arrays" in run.stdout, run.stdout
+    assert "w rank=2 first=7.0" in run.stdout, run.stdout
 
 
 def test_ndlist_reads_params_without_python(tmp_path):
