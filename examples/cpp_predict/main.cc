@@ -20,7 +20,8 @@
 
 int main(int argc, char** argv) {
   if (argc < 6) {
-    fprintf(stderr, "usage: %s model.onnx N C H W\n", argv[0]);
+    fprintf(stderr, "usage: %s model.onnx N C H W [weights.params]\n",
+            argv[0]);
     return 2;
   }
   PredictorHandle h;

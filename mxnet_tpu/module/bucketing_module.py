@@ -110,6 +110,11 @@ class BucketingModule(BaseModule):
             if self._curr_module.optimizer_initialized:
                 mod._optimizer = self._curr_module._optimizer
                 mod._updater = self._curr_module._updater
+                # the dist kvstore must follow the optimizer: a bucket
+                # updating without it would skip the gradient allreduce
+                # and silently diverge the workers
+                mod._kvstore = getattr(self._curr_module, "_kvstore",
+                                       None)
                 mod.optimizer_initialized = True
             self._buckets[bucket_key] = mod
         else:
@@ -159,10 +164,11 @@ class BucketingModule(BaseModule):
     def update(self):
         assert self.optimizer_initialized
         if not self._curr_module.optimizer_initialized:
-            self._curr_module._optimizer = \
-                self._buckets[self._default_bucket_key]._optimizer
-            self._curr_module._updater = \
-                self._buckets[self._default_bucket_key]._updater
+            default = self._buckets[self._default_bucket_key]
+            self._curr_module._optimizer = default._optimizer
+            self._curr_module._updater = default._updater
+            self._curr_module._kvstore = getattr(default, "_kvstore",
+                                                 None)
             self._curr_module.optimizer_initialized = True
         self._curr_module.update()
         # propagate updated params + aux (BN running stats) back to the

@@ -188,8 +188,13 @@ class Module(BaseModule):
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         """Reference: ``Module.init_optimizer``.  TPU note: there is one
-        logical parameter copy (XLA owns placement), so the
-        update-on-kvstore split collapses -- the Updater runs directly."""
+        logical parameter copy per process (XLA owns placement), so the
+        single-process update-on-kvstore split collapses -- the Updater
+        runs directly.  A ``dist*`` kvstore engages the multi-process
+        path: rank 0's parameters are broadcast (the reference's
+        kv.init + pull) and every ``update()`` allreduces gradients
+        across workers before the local update, exactly the
+        ``Module.fit(..., kvstore='dist_sync')`` workflow."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
@@ -205,6 +210,25 @@ class Module(BaseModule):
                                    **optimizer_params)
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
+        self._kvstore = None
+        if isinstance(kvstore, str):
+            if kvstore.startswith("dist"):
+                from .. import kvstore as kvs
+                self._kvstore = kvs.create(kvstore)
+        elif kvstore is not None:
+            self._kvstore = kvstore
+        if self._kvstore is not None and \
+                getattr(self._kvstore, "_is_dist", False):
+            # rank 0's parameters + aux go to every worker (reference
+            # kv.init + pull), however the kvstore was supplied
+            from ..distributed import host_broadcast, world
+            if world()[0] > 1:
+                for name in self._param_names:
+                    if name in self._exec.arg_dict:
+                        arr = self._exec.arg_dict[name]
+                        arr._data = host_broadcast(arr._data, root=0)
+                for name, arr in self._exec.aux_dict.items():
+                    arr._data = host_broadcast(arr._data, root=0)
         self.optimizer_initialized = True
         if getattr(self, "_preloaded_states", None):
             self.load_optimizer_states(self._preloaded_states)
@@ -237,13 +261,17 @@ class Module(BaseModule):
 
     def update(self):
         """Apply one optimizer step to every parameter (reference:
-        ``Module.update``)."""
+        ``Module.update``); with a dist kvstore, gradients allreduce
+        across workers first (``kvstore_dist.h :: Push/Pull``)."""
         assert self.optimizer_initialized
+        kv = getattr(self, "_kvstore", None)
         for i, name in enumerate(self._param_names):
             if name not in self._exec.grad_dict:
                 continue
-            self._updater(i, self._exec.grad_dict[name],
-                          self._exec.arg_dict[name])
+            grad = self._exec.grad_dict[name]
+            if kv is not None and getattr(kv, "_is_dist", False):
+                kv.pushpull(i, grad, out=grad)
+            self._updater(i, grad, self._exec.arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -190,6 +190,34 @@ for name, p in sorted(net.collect_params().items()):
     summed = np.asarray(host_allreduce(local))
     np.testing.assert_allclose(summed, 2.0 * local, rtol=1e-6,
                                err_msg=name)
+
+# --- legacy Module path: fit-style loop with kvstore='dist_sync' -----
+data = mx.sym.Variable("data")
+fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+act = mx.sym.Activation(fc, act_type="relu", name="relu1")
+out = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+smx = mx.sym.SoftmaxOutput(out, name="softmax")
+mod = mx.mod.Module(smx, context=mx.cpu())
+mod.bind(data_shapes=[("data", (16, 6))],
+         label_shapes=[("softmax_label", (16,))])
+mod.init_params(initializer=mx.init.Xavier())
+mod.init_optimizer(kvstore="dist_sync", optimizer="sgd",
+                   optimizer_params={"learning_rate": 0.1})
+mrng = np.random.RandomState(300 + rank)      # per-rank data
+for i in range(6):
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(mrng.randn(16, 6).astype(np.float32))],
+        label=[mx.nd.array(mrng.randint(0, 4, 16).astype(np.float32))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+args, _aux = mod.get_params()
+for name in sorted(args):
+    local = np.asarray(args[name].asnumpy(), np.float64)
+    summed = np.asarray(host_allreduce(local))
+    np.testing.assert_allclose(summed, 2.0 * local, rtol=1e-6,
+                               err_msg="module:" + name)
+
 print("TRAINER_WORKER_OK rank=%d loss %.4f -> %.4f" % (rank, first, last))
 """
 
