@@ -20,22 +20,11 @@ run_lint() {
     log "lint: byte-compile every source file"
     python -m compileall -q mxnet_tpu tools benchmark bench.py \
         __graft_entry__.py
-    log "lint: pyflakes-level check via compile+ast"
-    python - <<'EOF'
-import ast
-import pathlib
-import sys
-bad = []
-for p in pathlib.Path(".").glob("mxnet_tpu/**/*.py"):
-    tree = ast.parse(p.read_text(), str(p))
-    # cheap structural lint: no bare `except:` in library code
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            bad.append("%s:%d bare except" % (p, node.lineno))
-if bad:
-    sys.exit("\n".join(bad))
-print("lint clean")
-EOF
+    log "lint: mxnet_tpu.analysis self-check (trace-safety linter + retrace audit)"
+    # the same pass developers run locally as `mxlint` -- CI and the CLI
+    # cannot drift (docs/analysis.md); exits non-zero on any violation,
+    # --json keeps the record machine-readable for the gate log
+    python -m mxnet_tpu.analysis --self --json
 }
 
 run_suite() {

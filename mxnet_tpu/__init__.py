@@ -133,6 +133,7 @@ from . import contrib
 from . import env
 from . import preemption
 from . import horovod
+from . import analysis
 from . import name
 from . import attribute
 from .attribute import AttrScope

@@ -1,0 +1,35 @@
+"""``mxnet_tpu.analysis``: static graph checker + trace-safety linter
++ retrace auditor behind one pluggable rule framework.
+
+The reference validates graphs only at bind time and has no notion of
+jit-breaking Python; this subsystem catches both classes before any
+device time is spent (docs/analysis.md):
+
+- :func:`check_symbol` / :func:`assert_graph_ok` -- validate a
+  ``Symbol`` (shapes, dtypes, dangling/duplicate inputs, unknown ops).
+  Also available as an opt-in bind gate: ``Executor(..., check=True)``
+  or ``MXNET_TPU_GRAPH_CHECK=1``.
+- :func:`lint_paths` -- AST-lint source trees for trace-unsafe Python
+  (host syncs and value branches in compiled scopes, mutable defaults,
+  bare ``except:``).
+- :func:`audit_retrace` -- cross-reference op param specs with the
+  compile-cache keys to flag unbounded-recompilation hazards.
+
+CLI: ``python -m mxnet_tpu.analysis`` (or the ``mxlint`` entry point);
+``ci/run_all.sh lint`` runs it with ``--self``.  Add a rule with
+``@mxnet_tpu.analysis.rule(...)``.
+"""
+from .core import (Diagnostic, Rule, RULES, rule, get_rule, list_rules,
+                   render_human, render_json, ERROR, WARNING)
+from .graph_check import GraphCheckError, assert_graph_ok, check_symbol
+from .trace_lint import lint_file, lint_paths, lint_source
+from .retrace import audit_retrace
+from .cli import main
+
+__all__ = [
+    "Diagnostic", "Rule", "RULES", "rule", "get_rule", "list_rules",
+    "render_human", "render_json", "ERROR", "WARNING",
+    "GraphCheckError", "assert_graph_ok", "check_symbol",
+    "lint_file", "lint_paths", "lint_source",
+    "audit_retrace", "main",
+]

@@ -1,0 +1,6 @@
+"""``python -m mxnet_tpu.analysis`` -> the mxlint CLI."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,124 @@
+"""``mxlint`` / ``python -m mxnet_tpu.analysis`` -- the one CLI over
+all three analysis passes.
+
+Exit status: 1 when any error-severity diagnostic survives suppression
+(warnings too under ``--strict``), else 0 -- so CI gates on the exit
+code and consumes ``--json`` for reporting.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from .core import (ERROR, RULES, Diagnostic, render_human, render_json)
+
+__all__ = ["main"]
+
+# what ``--self`` lints: the package plus everything CI byte-compiles
+SELF_PATHS = ("mxnet_tpu", "examples", "tools", "benchmark", "bench.py",
+              "__graft_entry__.py")
+
+
+def _build_parser():
+    ap = argparse.ArgumentParser(
+        prog="mxlint",
+        description="Static graph checker + trace-safety linter + "
+                    "retrace auditor for mxnet_tpu (docs/analysis.md).")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories to trace-lint")
+    ap.add_argument("--self", dest="self_check", action="store_true",
+                    help="lint the repository itself (%s) and run the "
+                         "retrace audit -- the CI lint gate"
+                         % " ".join(SELF_PATHS))
+    ap.add_argument("--graph", action="append", default=[],
+                    metavar="SYMBOL_JSON",
+                    help="run the static graph checker over a saved "
+                         "-symbol.json (repeatable)")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="NAME=SHAPE",
+                    help="input shape for --graph checking, e.g. "
+                         "data=1,3,224,224 (repeatable)")
+    ap.add_argument("--retrace", action="store_true",
+                    help="audit registry op params against the "
+                         "hybridize cache key")
+    ap.add_argument("--disable", default="", metavar="RULES",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print every registered rule and exit")
+    return ap
+
+
+def _parse_shapes(specs) -> dict:
+    shapes = {}
+    for spec in specs:
+        name, _, dims = spec.partition("=")
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+    return shapes
+
+
+def _list_rules() -> str:
+    lines = []
+    for r in sorted(RULES.values(), key=lambda r: (r.kind, r.id)):
+        lines.append("%-20s %-9s %-8s %s"
+                     % (r.id, r.kind, r.severity, r.doc))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    # importing the passes registers their rules
+    from . import graph_check, retrace, trace_lint
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+
+    ignore = set(filter(None, args.disable.split(",")))
+    diags: List[Diagnostic] = []
+
+    paths = list(args.paths)
+    run_retrace = args.retrace
+    if args.self_check:
+        import os
+        paths.extend(p for p in SELF_PATHS if os.path.exists(p))
+        run_retrace = True
+
+    if paths:
+        diags.extend(trace_lint.lint_paths(paths, ignore=ignore))
+
+    for gpath in args.graph:
+        from ..symbol import load as sym_load
+        from ..base import MXNetError
+        try:
+            sym = sym_load(gpath)
+        except (MXNetError, OSError, ValueError, KeyError) as e:
+            diags.append(Diagnostic("graph-load",
+                                    "cannot load %s: %s" % (gpath, e),
+                                    file=gpath, line=0))
+            continue
+        for d in graph_check.check_symbol(
+                sym, shapes=_parse_shapes(args.shape), ignore=ignore):
+            d.file = gpath
+            diags.append(d)
+
+    if run_retrace:
+        diags.extend(d for d in retrace.audit_retrace()
+                     if d.rule not in ignore)
+
+    if not paths and not args.graph and not run_retrace:
+        _build_parser().print_usage()
+        return 2
+
+    print(render_json(diags) if args.as_json else render_human(diags))
+    failing = [d for d in diags
+               if d.severity == ERROR or args.strict]
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

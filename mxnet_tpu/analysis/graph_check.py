@@ -1,0 +1,269 @@
+"""Static graph checker: validate a ``Symbol`` before any device time.
+
+The reference's nnvm passes (InferShape/InferType, graph validation in
+``GraphExecutor::Init``) abort the *bind*; this pass runs the same
+class of checks standalone -- over ``Symbol._topo()`` with
+``jax.eval_shape`` as the oracle -- and reports every problem at once
+as :class:`~mxnet_tpu.analysis.core.Diagnostic`s instead of raising on
+the first.
+
+Structural rules (no shape info needed):
+
+- ``unknown-op``          op name missing from the registry
+- ``dangling-input``      op node with unfilled required tensor slots
+- ``duplicate-input``     two distinct variable nodes sharing a name
+
+Shape/dtype rules (need input shapes, given or via ``__shape__`` attrs):
+
+- ``shape-contradiction`` ``jax.eval_shape`` rejects a node whose input
+                          shapes are all known
+- ``unknown-shape``       a variable's shape cannot be deduced (warning)
+- ``dtype-promotion``     a node mixes input dtypes, triggering implicit
+                          promotion (warning; fp32 upcasts hiding an
+                          intended bf16 path are a classic TPU perf bug)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .core import Diagnostic, ERROR, WARNING, rule
+
+__all__ = ["check_symbol", "GraphCheckError", "assert_graph_ok"]
+
+
+class GraphCheckError(MXNetError):
+    """Raised by :func:`assert_graph_ok`; carries the diagnostics."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        msg = "graph check failed:\n" + "\n".join(
+            d.format() for d in self.diagnostics)
+        super().__init__(msg)
+
+
+# ----------------------------------------------------------------------
+# structural rules
+# ----------------------------------------------------------------------
+
+@rule("unknown-op", "graph",
+      "An op node names an operator missing from the registry; binding "
+      "would fail at dispatch time.")
+def _check_unknown_op(sym, ctx):
+    from ..ops.registry import OP_REGISTRY
+    for node in sym._topo():
+        if node.op is not None and node.op not in OP_REGISTRY:
+            yield Diagnostic("unknown-op",
+                             "op %r is not in the registry" % node.op,
+                             node=node.name)
+
+
+@rule("dangling-input", "graph",
+      "An op node has fewer inputs than its registered signature "
+      "requires (a structurally-required tensor slot is unfilled).")
+def _check_dangling_input(sym, ctx):
+    from ..ops.registry import OP_REGISTRY
+    from ..symbol.symbol import _node_params, _skip_auto_var
+    for node in sym._topo():
+        op = OP_REGISTRY.get(node.op) if node.op is not None else None
+        if op is None or op.variadic:
+            continue
+        params = _node_params(node, op)
+        required = [a for a in op.arg_names
+                    if not _skip_auto_var(node.op, params, a)]
+        if len(node.inputs) < len(required):
+            missing = required[len(node.inputs):]
+            yield Diagnostic(
+                "dangling-input",
+                "op %s(%s) is missing tensor input(s) %r"
+                % (node.op, node.name, missing), node=node.name)
+
+
+@rule("duplicate-input", "graph",
+      "Two distinct variable nodes share one name, so a single feed "
+      "entry silently binds both.")
+def _check_duplicate_input(sym, ctx):
+    seen: Dict[str, int] = {}
+    for node in sym._topo():
+        if node.op is not None:
+            continue
+        if node.name in seen:
+            yield Diagnostic(
+                "duplicate-input",
+                "variable name %r is used by %d distinct input nodes; "
+                "binding by name is ambiguous"
+                % (node.name, seen[node.name] + 1), node=node.name)
+        seen[node.name] = seen.get(node.name, 0) + 1
+
+
+# ----------------------------------------------------------------------
+# shape/dtype walk (forward abstract interpretation, error-collecting
+# twin of symbol._infer_shapes_forward)
+# ----------------------------------------------------------------------
+
+def _shape_walk(sym, known):
+    """Yield diagnostics; shares the per-op deduction rules with
+    ``infer_shape`` so the checker and the binder can never disagree."""
+    import jax
+    import numpy as np
+
+    from ..ops.registry import OP_REGISTRY
+    from ..symbol.symbol import (_node_params, _param_shape_rule,
+                                 _parse_attr_value)
+
+    known = {k: tuple(v) for k, v in (known or {}).items()}
+    specs = {}                       # (id(node), oi) -> ShapeDtypeStruct
+    reported_unknown = set()
+
+    def report_unknown(name):
+        if name not in reported_unknown:
+            reported_unknown.add(name)
+            yield Diagnostic(
+                "unknown-shape",
+                "shape of input %r cannot be deduced; pass it to the "
+                "checker or annotate the variable" % name,
+                node=name, severity=WARNING)
+
+    for node in sym._topo():
+        if node.op is None:
+            if node.name in known:
+                shape = known[node.name]
+            elif "__shape__" in node.attrs:
+                shape = tuple(_parse_attr_value(node.attrs["__shape__"]))
+            else:
+                continue
+            if any(not isinstance(d, int) or d <= 0 for d in shape):
+                # deferred-init shape (0 = unknown dim, e.g. a conv
+                # weight before in_channels is seen): leave it to the
+                # per-op deduction rule at the consumer
+                continue
+            dt = np.dtype(str(node.attrs.get("__dtype__", "float32")))
+            specs[(id(node), 0)] = jax.ShapeDtypeStruct(shape, dt)
+            continue
+        op = OP_REGISTRY.get(node.op)
+        if op is None:
+            continue                 # unknown-op already reported
+        params = _node_params(node, op)
+        in_shapes = [specs.get((id(src), oi)) for src, oi in node.inputs]
+        in_shapes = [tuple(s.shape) if s is not None else None
+                     for s in in_shapes]
+        in_specs = []
+        unresolved = False
+        for i, (src, oi) in enumerate(node.inputs):
+            s = specs.get((id(src), oi))
+            if s is None and src.op is None:
+                arg = op.arg_names[i] if i < len(op.arg_names) else ""
+                shape = _param_shape_rule(node.op, params, arg, in_shapes)
+                if shape is not None:
+                    s = jax.ShapeDtypeStruct(shape, np.float32)
+                    specs[(id(src), oi)] = s
+            if s is None:
+                if src.op is None:
+                    yield from report_unknown(src.name)
+                unresolved = True
+            in_specs.append(s)
+        if unresolved:
+            continue
+        in_dtypes = {str(s.dtype) for s in in_specs}
+        if len(in_dtypes) > 1:
+            yield Diagnostic(
+                "dtype-promotion",
+                "op %s(%s) mixes input dtypes %s; the result is "
+                "implicitly promoted" % (node.op, node.name,
+                                         sorted(in_dtypes)),
+                node=node.name, severity=WARNING)
+        pad = 0
+        if not op.variadic and len(in_specs) < len(op.arg_names):
+            pad = len(op.arg_names) - len(in_specs)
+        fn = op.fcompute
+        if op.stateful_rng:
+            fn = functools.partial(fn, jax.random.PRNGKey(0))
+        if any(p.name == "training" for p in op.params) and \
+                "training" not in node.attrs:
+            params["training"] = False
+        try:
+            out = jax.eval_shape(
+                lambda *a: fn(*(list(a) + [None] * pad), **params),
+                *in_specs)
+        except Exception as e:
+            yield Diagnostic(
+                "shape-contradiction",
+                "op %s(%s) rejects input shapes %s: %s"
+                % (node.op, node.name,
+                   [tuple(s.shape) for s in in_specs], e),
+                node=node.name)
+            continue
+        if isinstance(out, (tuple, list)):
+            for i, o in enumerate(out):
+                specs[(id(node), i)] = o
+        else:
+            specs[(id(node), 0)] = out
+
+
+@rule("shape-contradiction", "graph",
+      "Forward shape propagation (jax.eval_shape over the op's compute "
+      "function) rejects a node whose input shapes are all known.")
+def _check_shapes(sym, ctx):
+    for d in _shape_walk(sym, (ctx or {}).get("shapes")):
+        if d.rule == "shape-contradiction":
+            yield d
+
+
+@rule("unknown-shape", "graph",
+      "A variable's shape is neither given nor deducible, leaving part "
+      "of the graph unvalidated.", severity=WARNING)
+def _check_unknown_shape(sym, ctx):
+    for d in _shape_walk(sym, (ctx or {}).get("shapes")):
+        if d.rule == "unknown-shape":
+            yield d
+
+
+@rule("dtype-promotion", "graph",
+      "A node mixes input dtypes; implicit promotion can silently "
+      "upcast a reduced-precision path to fp32.", severity=WARNING)
+def _check_dtype_promotion(sym, ctx):
+    for d in _shape_walk(sym, (ctx or {}).get("shapes")):
+        if d.rule == "dtype-promotion":
+            yield d
+
+
+# ----------------------------------------------------------------------
+# driver
+# ----------------------------------------------------------------------
+
+_STRUCTURAL = ("unknown-op", "dangling-input", "duplicate-input")
+
+
+def check_symbol(sym, shapes: Optional[Dict[str, tuple]] = None,
+                 structural_only: bool = False,
+                 ignore=()) -> List[Diagnostic]:
+    """Run every graph rule over ``sym``; returns all diagnostics.
+
+    ``shapes`` maps input names to shapes (like ``infer_shape`` kwargs).
+    ``structural_only`` skips the shape walk (cheap enough for a bind
+    gate even on large graphs).  ``ignore`` drops the listed rule ids.
+    """
+    from .core import RULES
+    diags: List[Diagnostic] = []
+    for rid in _STRUCTURAL:
+        if rid in ignore:
+            continue
+        diags.extend(RULES[rid].check(sym, None))
+    if not structural_only:
+        # one walk, routed by rule id (the per-rule wrappers exist for
+        # --list-rules discoverability; the driver avoids 3x the work)
+        for d in _shape_walk(sym, shapes):
+            if d.rule not in ignore:
+                diags.append(d)
+    return diags
+
+
+def assert_graph_ok(sym, shapes=None, structural_only=False, ignore=()):
+    """Raise :class:`GraphCheckError` when any error-severity diagnostic
+    fires -- the opt-in bind gate used by ``Executor``."""
+    diags = [d for d in check_symbol(sym, shapes, structural_only, ignore)
+             if d.severity == ERROR]
+    if diags:
+        raise GraphCheckError(diags)
+    return True

@@ -1,0 +1,140 @@
+"""Retrace auditor: flag op params that defeat the compile caches.
+
+Two compile caches key execution, and op params sit differently in each:
+
+- The **eager** per-op cache (``ndarray/ndarray.py``) keys on
+  ``(op, shapes, dtypes, params, amp)`` but threads the names in
+  ``_DYNAMIC_PARAMS`` as *traced* scalars, so a per-step learning rate
+  does not recompile.
+- The **hybridize** cache (``gluon/block.py :: _CACHE_KEY_STATIC``)
+  keys on ``(training, amp-policy, shapes, dtypes)`` only; op params
+  are baked into the trace as compile-time constants.
+
+An op param whose name marks it as per-step-varying (a schedule, a
+step counter, a loss scale) that is NOT in the eager dynamic set is an
+unbounded-recompilation hazard: every distinct value compiles a fresh
+XLA executable.  The seed had exactly one -- ``lamb_update_phase1``'s
+``t`` recompiled LAMB on every step until it joined ``_DYNAMIC_PARAMS``.
+
+Rules:
+
+- ``retrace-hazard``  (warning) varying-named op param outside the
+  eager dynamic set
+- ``cache-key-drift`` (warning) the cache-key anchors this audit reads
+  (``_CACHE_KEY_STATIC``, ``_DYNAMIC_PARAMS``) are gone or no longer
+  cover what the audit assumes -- the engine changed; update the audit
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+from typing import List
+
+from .core import Diagnostic, WARNING, rule
+
+__all__ = ["audit_retrace", "cache_key_fields", "eager_dynamic_params",
+           "VARYING_PARAM_NAMES"]
+
+# Param names that, by convention in this registry, carry per-step
+# values (optimizer schedules, step counters, loss scaling).  Constant
+# hyperparameters with trace-time control flow (``clip_gradient``) and
+# shape-like params (``step`` strides) are deliberately excluded.
+VARYING_PARAM_NAMES = {
+    "lr", "wd", "rescale_grad", "scalar", "t", "loss_scale", "num_update",
+}
+
+
+def eager_dynamic_params() -> frozenset:
+    """The eager engine's dynamically-threaded param names."""
+    from ..ndarray import ndarray as nd_impl
+    return getattr(nd_impl, "_DYNAMIC_PARAMS", frozenset())
+
+
+def cache_key_fields() -> List[str]:
+    """Static fields of the hybridize compiled-entry cache key, from
+    ``gluon/block.py`` (empty list if the anchor is unparseable)."""
+    from ..gluon import block as block_mod
+    static = getattr(block_mod, "_CACHE_KEY_STATIC", None)
+    if static is not None:
+        return list(static)
+    # fallback: recover the key tuple from the source (pre-constant
+    # versions of block.py)
+    try:
+        src = inspect.getsource(block_mod.HybridBlock._call_cached)
+        tree = ast.parse("if 1:\n" + src)
+    except (OSError, SyntaxError, TypeError):
+        return []
+    fields: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "key"
+                for t in node.targets):
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name):
+                    fields.append(sub.id)
+                elif isinstance(sub, ast.Attribute):
+                    fields.append(sub.attr)
+    return fields
+
+
+@rule("retrace-hazard", "registry",
+      "An op param carries a per-step-varying value that is a trace-"
+      "time constant in every compile cache; each distinct value "
+      "forces an XLA recompile.", severity=WARNING)
+def _audit_varying_params(ctx):
+    from ..ops.registry import OP_REGISTRY
+    dynamic = eager_dynamic_params()
+    seen = set()
+    for _, op in sorted(OP_REGISTRY.items()):
+        if id(op) in seen:           # aliases share the Op object
+            continue
+        seen.add(id(op))
+        hazards = [p.name for p in op.params
+                   if p.name in VARYING_PARAM_NAMES and p.name not in dynamic]
+        if hazards:
+            yield Diagnostic(
+                "retrace-hazard",
+                "op %r params %r vary per step but are static in both "
+                "compile caches (eager _DYNAMIC_PARAMS and the "
+                "hybridize key %s); each distinct value recompiles -- "
+                "add them to _DYNAMIC_PARAMS or thread them as tensor "
+                "inputs" % (op.name, hazards, cache_key_fields()),
+                node=op.name, severity=WARNING)
+
+
+@rule("cache-key-drift", "registry",
+      "The compile-cache key anchors this audit reads no longer match "
+      "what it expects; update the audit with the engine.",
+      severity=WARNING)
+def _audit_cache_key(ctx):
+    fields = cache_key_fields()
+    expected = {"training", "shape", "dtype"}
+    missing = expected - set(fields)
+    if not fields or missing:
+        yield Diagnostic(
+            "cache-key-drift",
+            "could not confirm hybridize cache-key fields %s in "
+            "gluon/block.py (found %s); the retrace audit may be stale"
+            % (sorted(expected), sorted(set(fields))),
+            severity=WARNING)
+    if not eager_dynamic_params():
+        yield Diagnostic(
+            "cache-key-drift",
+            "ndarray._DYNAMIC_PARAMS is missing or empty; the eager "
+            "per-op cache no longer threads per-step params and the "
+            "retrace audit may be stale", severity=WARNING)
+
+
+def audit_retrace() -> List[Diagnostic]:
+    """Run every registry-kind rule; imports the op modules first so
+    the registry is fully populated."""
+    import mxnet_tpu.ops  # noqa: F401  (populates OP_REGISTRY)
+    from .core import RULES
+    diags: List[Diagnostic] = []
+    for r in RULES.values():
+        if r.kind != "registry":
+            continue
+        for d in r.check(None):
+            d.severity = r.severity
+            diags.append(d)
+    return diags

@@ -1,0 +1,296 @@
+"""Trace-safety AST linter for compiled (hybridized/jitted) paths.
+
+The JAX lowering adds a failure class the reference never had: Python
+that is fine eagerly but breaks (or silently de-optimizes) under
+``jax.jit`` tracing.  A ``hybrid_forward`` body is traced by the
+CachedOp engine (``gluon/block.py``), so inside it:
+
+- host syncs (``.asnumpy()``, ``float(x)``, ``np.asarray(x)``) raise a
+  ``TracerArrayConversionError`` at trace time;
+- Python ``if``/``while`` on a traced *value* raises a
+  ``TracerBoolConversionError`` (branching on ``is None`` /
+  ``isinstance`` / shapes is structural and fine -- shapes are static
+  under jit);
+
+and everywhere in library code:
+
+- mutable default arguments alias state across calls;
+- bare ``except:`` swallows ``KeyboardInterrupt``/preemption SIGTERM
+  handling (migrated from the old inline CI check).
+
+Suppress a finding with ``# mxlint: disable=<rule>`` on its line.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List
+
+from .core import Diagnostic, filter_suppressed, rule
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "TRACED_SCOPES"]
+
+# Method names whose bodies run under the tracer.  ``hybrid_forward`` is
+# the public contract; ``_forward_impl`` is the engine-internal twin the
+# cache actually traces (HybridSequential overrides it directly).
+TRACED_SCOPES = ("hybrid_forward", "_forward_impl")
+
+# attribute reads that touch only static metadata of a traced value
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "name"}
+# calls that inspect structure, not value
+_STATIC_CALLS = {"isinstance", "len", "hasattr", "type", "getattr",
+                 "enumerate", "zip", "range", "list", "tuple", "id"}
+# method calls that force a device->host transfer of a traced value
+_SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist", "wait_to_read"}
+# builtins that coerce a traced value to a Python scalar
+_COERCIONS = {"float", "int", "bool", "complex"}
+# numpy module aliases whose array constructors pull values to host
+_NP_MODULES = {"np", "numpy", "onp"}
+_NP_SYNC_FUNCS = {"asarray", "array", "asanyarray", "ascontiguousarray"}
+
+
+def _traced_value_uses(expr, traced) -> List[ast.Name]:
+    """Name nodes in ``expr`` that read a traced value's *data* (uses
+    behind static metadata/structure accessors don't count)."""
+    if expr is None:
+        return []
+    if isinstance(expr, ast.Name):
+        return [expr] if expr.id in traced else []
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in _STATIC_ATTRS:
+            return []
+        return _traced_value_uses(expr.value, traced)
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        fname = f.id if isinstance(f, ast.Name) else \
+            (f.attr if isinstance(f, ast.Attribute) else None)
+        if fname in _STATIC_CALLS:
+            return []
+        out = _traced_value_uses(f, traced)
+        for a in expr.args:
+            out += _traced_value_uses(a, traced)
+        for k in expr.keywords:
+            out += _traced_value_uses(k.value, traced)
+        return out
+    if isinstance(expr, ast.Compare):
+        # identity checks (x is None / x is not y) are structural
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return []
+    out = []
+    for child in ast.iter_child_nodes(expr):
+        out += _traced_value_uses(child, traced)
+    return out
+
+
+def _traced_names(fn: ast.FunctionDef) -> set:
+    """Initial traced-value bindings of a traced scope: every tensor
+    parameter (positional after self/F, kw-only, and **params)."""
+    args = fn.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    skip = 1 if pos and pos[0] == "self" else 0
+    if fn.name == "hybrid_forward" and len(pos) > skip and \
+            pos[skip] == "F":
+        skip += 1
+    names = set(pos[skip:])
+    names.update(a.arg for a in args.kwonlyargs)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+class _TracedScopeVisitor(ast.NodeVisitor):
+    """Walks one traced scope, propagating taint through assignments."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.traced = _traced_names(fn)
+        self.host_syncs: List[Diagnostic] = []
+        self.branches: List[Diagnostic] = []
+
+    def run(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+        return self
+
+    # taint propagation: a name assigned from an expression that reads a
+    # traced value becomes traced itself
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        if _traced_value_uses(node.value, self.traced):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        self.traced.add(n.id)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        if _traced_value_uses(node.value, self.traced) and \
+                isinstance(node.target, ast.Name):
+            self.traced.add(node.target.id)
+
+    def visit_FunctionDef(self, node):
+        pass                          # nested defs get their own scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS and \
+                _traced_value_uses(f.value, self.traced):
+            self._sync(node, ".%s() forces a device->host sync" % f.attr)
+        elif isinstance(f, ast.Name) and f.id in _COERCIONS and \
+                any(_traced_value_uses(a, self.traced) for a in node.args):
+            self._sync(node, "%s() coerces a traced value on host" % f.id)
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in _NP_MODULES and f.attr in _NP_SYNC_FUNCS and \
+                any(_traced_value_uses(a, self.traced) for a in node.args):
+            self._sync(node, "%s.%s() materializes a traced value as a "
+                       "host numpy array" % (f.value.id, f.attr))
+
+    def _sync(self, node, what):
+        self.host_syncs.append(Diagnostic(
+            "host-sync",
+            "%s inside %s; under hybridize/jit this raises at trace "
+            "time -- keep the value on device (F./mx.nd ops) or compute "
+            "it outside the compiled path" % (what, self.fn.name),
+            line=node.lineno))
+
+    def _branch(self, node, kw):
+        uses = _traced_value_uses(node.test, self.traced)
+        if uses:
+            self.branches.append(Diagnostic(
+                "tracer-branch",
+                "`%s` on traced value(s) %s inside %s; data-dependent "
+                "Python control flow breaks tracing -- use F.where/"
+                "lax.cond-style select instead"
+                % (kw, sorted({u.id for u in uses}), self.fn.name),
+                line=node.lineno))
+
+    def visit_If(self, node):
+        self._branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        # assert on a traced value is a bool coercion too
+        uses = _traced_value_uses(node.test, self.traced)
+        if uses:
+            self.branches.append(Diagnostic(
+                "tracer-branch",
+                "`assert` on traced value(s) %s inside %s; use "
+                "explicit shape checks or F.where"
+                % (sorted({u.id for u in uses}), self.fn.name),
+                line=node.lineno))
+        self.generic_visit(node)
+
+
+def _traced_scopes(tree) -> Iterable[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name in TRACED_SCOPES:
+            yield node
+
+
+# ----------------------------------------------------------------------
+# rules
+# ----------------------------------------------------------------------
+
+@rule("bare-except", "ast",
+      "Bare `except:` catches KeyboardInterrupt and the preemption "
+      "SIGTERM path; name the exception type (was the inline CI check).")
+def _lint_bare_except(tree, path, ctx):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield Diagnostic("bare-except",
+                             "bare `except:`; catch a named exception "
+                             "type", file=path, line=node.lineno)
+
+
+@rule("mutable-default", "ast",
+      "A mutable default argument (list/dict/set literal) is shared "
+      "across every call of the function.")
+def _lint_mutable_default(tree, path, ctx):
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + \
+            [d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+                yield Diagnostic(
+                    "mutable-default",
+                    "function %r has a mutable default argument; use "
+                    "None and create it in the body" % node.name,
+                    file=path, line=d.lineno)
+
+
+@rule("host-sync", "ast",
+      "A device->host transfer (.asnumpy()/.item()/float()/np.asarray) "
+      "on a traced value inside a compiled scope fails at trace time.")
+def _lint_host_sync(tree, path, ctx):
+    for fn in _traced_scopes(tree):
+        for d in _TracedScopeVisitor(fn).run().host_syncs:
+            d.file = path
+            yield d
+
+
+@rule("tracer-branch", "ast",
+      "Python if/while/assert on a traced value inside a compiled "
+      "scope; data-dependent control flow breaks tracing.")
+def _lint_tracer_branch(tree, path, ctx):
+    for fn in _traced_scopes(tree):
+        for d in _TracedScopeVisitor(fn).run().branches:
+            d.file = path
+            yield d
+
+
+# ----------------------------------------------------------------------
+# drivers
+# ----------------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>",
+                ignore=()) -> List[Diagnostic]:
+    """Lint one source string; applies ``# mxlint: disable`` comments."""
+    from .core import RULES
+    try:
+        tree = ast.parse(source, path)
+    except SyntaxError as e:
+        return [Diagnostic("syntax-error", str(e), file=path,
+                           line=e.lineno or 1)]
+    diags: List[Diagnostic] = []
+    for r in RULES.values():
+        if r.kind != "ast" or r.id in ignore:
+            continue
+        for d in r.check(tree, path, None):
+            d.severity = r.severity
+            diags.append(d)
+    diags.sort(key=lambda d: (d.line or 0, d.rule))
+    return filter_suppressed(diags, source.splitlines())
+
+
+def lint_file(path, ignore=()) -> List[Diagnostic]:
+    p = Path(path)
+    return lint_source(p.read_text(), str(p), ignore=ignore)
+
+
+def lint_paths(paths, ignore=()) -> List[Diagnostic]:
+    """Lint files and/or directories (recursing into ``**/*.py``)."""
+    diags: List[Diagnostic] = []
+    for path in paths:
+        p = Path(path)
+        if not p.exists():
+            diags.append(Diagnostic("no-such-path",
+                                    "path does not exist", file=str(p)))
+            continue
+        files = sorted(p.glob("**/*.py")) if p.is_dir() else [p]
+        for f in files:
+            diags.extend(lint_file(f, ignore=ignore))
+    return diags

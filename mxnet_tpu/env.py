@@ -99,6 +99,12 @@ _VARS = [
            "emit first, and optional configs that would exceed the "
            "budget print a skipped line instead of running (so the "
            "bench can never outlive the driver's timeout)."),
+    EnvVar("MXNET_TPU_GRAPH_CHECK", bool, False,
+           "'1' runs the static graph checker (mxnet_tpu.analysis) on "
+           "every Executor bind/simple_bind, raising GraphCheckError "
+           "with every problem at once (unknown ops, dangling or "
+           "duplicate inputs, shape contradictions) before any device "
+           "time is spent.  Per-bind override: bind(..., check=True)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

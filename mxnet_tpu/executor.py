@@ -35,7 +35,8 @@ class Executor:
     """Bound executor (reference: ``Executor.forward/backward/outputs``)."""
 
     def __init__(self, symbol, ctx=None, args=None, args_grad=None,
-                 grad_req="write", aux_states=None, group2ctx=None):
+                 grad_req="write", aux_states=None, group2ctx=None,
+                 check=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = dict(group2ctx) if group2ctx else None
@@ -54,6 +55,19 @@ class Executor:
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(self.aux_names, aux_states))
         self.aux_dict = dict(aux_states or {})
+        # Opt-in static graph gate (mxnet_tpu.analysis): validate the
+        # whole graph -- structure plus shape/dtype propagation over the
+        # bound arrays -- before any device time is spent.  Off by
+        # default (bind stays cheap); enable per-bind with check=True
+        # or globally with MXNET_TPU_GRAPH_CHECK=1.
+        if check is None:
+            from . import env as _env
+            check = _env.get("MXNET_TPU_GRAPH_CHECK")
+        if check:
+            from .analysis import assert_graph_ok
+            shapes = {k: tuple(v.shape)
+                      for k, v in {**self.arg_dict, **self.aux_dict}.items()}
+            assert_graph_ok(symbol, shapes=shapes or None)
         self.outputs = []
         self._fwd_jit = None
         self._train_jit = None

@@ -325,6 +325,15 @@ class Block:
         return "\n".join(lines)
 
 
+# Static fields of the compiled-entry cache key built in
+# ``HybridBlock._call_cached``.  Op params are intentionally absent:
+# they are baked into each trace as compile-time constants.  The
+# retrace auditor (``mxnet_tpu.analysis.retrace``) cross-references
+# this tuple against the op registry's param specs -- keep it in sync
+# with the ``key = ...`` expression below.
+_CACHE_KEY_STATIC = ("training", "amp_policy", "shape", "dtype")
+
+
 class _CacheEntry:
     """One compiled specialization of a hybridized block."""
 

@@ -615,11 +615,13 @@ def _canon_param(v):
     return v
 
 
-# Float params that vary per call (per-step lr/wd schedules, arbitrary
-# `x + c` scalars): traced as weak-typed jit arguments so a new VALUE
-# does not mean a new XLA compilation.  Everything else (flags, shapes,
-# clip thresholds with Python control flow) stays static in the key.
-_DYNAMIC_PARAMS = frozenset(("lr", "wd", "rescale_grad", "scalar"))
+# Params that vary per call (per-step lr/wd schedules, step counters,
+# arbitrary `x + c` scalars): traced as weak-typed jit arguments so a
+# new VALUE does not mean a new XLA compilation.  Everything else
+# (flags, shapes, clip thresholds with Python control flow) stays
+# static in the key.  The retrace auditor (mxnet_tpu.analysis.retrace)
+# cross-references this set against the registry's param specs.
+_DYNAMIC_PARAMS = frozenset(("lr", "wd", "rescale_grad", "scalar", "t"))
 
 
 def _eager_jit_fn(op, params, present, total_args):

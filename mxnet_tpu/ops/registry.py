@@ -116,10 +116,20 @@ def register(name: str, args: Sequence[str] = ("data",), variadic: bool = False,
                 num_diff_outputs=num_diff_outputs, stateful_rng=stateful_rng)
         op.doc = (op.doc + "\n\n" + build_param_doc(params)) if params else op.doc
         if name in OP_REGISTRY:
-            raise MXNetError("duplicate op registration: %s" % name)
+            raise MXNetError(
+                "duplicate op registration: %r is already registered "
+                "as %r; pick a distinct name or register an alias on "
+                "the existing op" % (name, OP_REGISTRY[name].name))
         OP_REGISTRY[name] = op
         for a in aliases:
-            OP_REGISTRY.setdefault(a, op)
+            # an alias silently shadowing another op would make graph
+            # dispatch depend on import order -- reject it loudly
+            if a in OP_REGISTRY and OP_REGISTRY[a] is not op:
+                raise MXNetError(
+                    "duplicate op alias registration: %r on op %r is "
+                    "already bound to op %r" % (a, name,
+                                                OP_REGISTRY[a].name))
+            OP_REGISTRY[a] = op
         return op
     return deco
 
@@ -128,7 +138,12 @@ def get_op(name: str) -> Op:
     try:
         return OP_REGISTRY[name]
     except KeyError:
-        raise MXNetError("unknown operator %r" % name) from None
+        import difflib
+        close = difflib.get_close_matches(str(name), OP_REGISTRY, n=3,
+                                          cutoff=0.6)
+        hint = "; did you mean %s?" % " or ".join(repr(c) for c in close) \
+            if close else " (see mxnet_tpu.ops.list_ops())"
+        raise MXNetError("unknown operator %r%s" % (name, hint)) from None
 
 
 def list_ops() -> List[str]:

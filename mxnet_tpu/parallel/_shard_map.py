@@ -1,0 +1,28 @@
+"""Version-compat import of ``shard_map``.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the
+top-level namespace and renamed its replication-check kwarg
+(``check_rep`` -> ``check_vma``) across releases; the parallel modules
+import from here so they run on either side of the move.
+"""
+from __future__ import annotations
+
+import inspect
+
+try:                               # jax >= 0.5: top-level
+    from jax import shard_map as _shard_map
+except ImportError:                # jax 0.4.x: experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _params = inspect.signature(_shard_map).parameters
+    _CHECK_KW = "check_vma" if "check_vma" in _params else "check_rep"
+except (TypeError, ValueError):
+    _CHECK_KW = "check_vma"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})

@@ -49,7 +49,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
     (M, mb, ...) outputs.  Differentiable end-to-end (ppermute
     transposes to the reverse rotation).
     """
-    from jax import shard_map as _sm
+    from ._shard_map import shard_map as _sm
     shard_map = functools.partial(_sm, check_vma=False)
 
     S = mesh.shape[axis]

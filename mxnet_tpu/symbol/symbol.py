@@ -189,15 +189,18 @@ class Symbol:
         return outs
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, **kwargs):
+             aux_states=None, group2ctx=None, check=None, **kwargs):
         from ..executor import Executor
         return Executor(self, ctx, args, args_grad, grad_req,
-                        aux_states=aux_states, group2ctx=group2ctx)
+                        aux_states=aux_states, group2ctx=group2ctx,
+                        check=check)
 
-    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+    def simple_bind(self, ctx=None, grad_req="write", check=None, **shapes):
         """Allocate all arguments and bind (reference: ``simple_bind``).
         Parameter shapes not passed explicitly are inferred from the
-        data/label shapes via ``infer_shape``."""
+        data/label shapes via ``infer_shape``.  ``check=True`` (or
+        ``MXNET_TPU_GRAPH_CHECK=1``) runs the static graph checker
+        (``mxnet_tpu.analysis``) before binding."""
         from ..executor import Executor
         from ..ndarray import zeros
         arg_names = self.list_arguments()
@@ -210,7 +213,7 @@ class Symbol:
                for name, shape in zip(self.list_auxiliary_states(),
                                       aux_shapes)}
         return Executor(self, ctx, args, args_grad, grad_req,
-                        aux_states=aux)
+                        aux_states=aux, check=check)
 
     # -- serialization (reference: nnvm saveload_json.cc) -------------
     def tojson(self):

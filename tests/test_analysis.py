@@ -1,0 +1,340 @@
+"""mxnet_tpu.analysis: graph checker, trace-safety linter, retrace
+auditor, CLI, and the bind gate (reference for the lint half: the
+repo's old inline CI AST check, now rule ``bare-except``)."""
+import json
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis as an
+from mxnet_tpu.base import MXNetError
+
+
+def _rules_of(diags):
+    return sorted({d.rule for d in diags})
+
+
+def _lint(src):
+    return an.lint_source(src, "probe.py")
+
+
+# ----------------------------------------------------------------------
+# trace linter: one positive and one negative fixture per rule
+# ----------------------------------------------------------------------
+
+def test_bare_except_fires_and_clean_twin_silent():
+    bad = "try:\n    pass\nexcept:\n    pass\n"
+    good = "try:\n    pass\nexcept ValueError:\n    pass\n"
+    assert _rules_of(_lint(bad)) == ["bare-except"]
+    assert _lint(good) == []
+
+
+def test_mutable_default_fires_and_clean_twin_silent():
+    bad = "def f(a=[], b={}):\n    return a, b\n"
+    good = "def f(a=None, b=()):\n    return a, b\n"
+    assert _rules_of(_lint(bad)) == ["mutable-default"]
+    assert _lint(good) == []
+
+
+def test_host_sync_fires_and_clean_twin_silent():
+    bad = (
+        "class M:\n"
+        "    def hybrid_forward(self, F, x, weight):\n"
+        "        v = float(x.sum())\n"
+        "        n = x.asnumpy()\n"
+        "        a = np.asarray(weight)\n"
+        "        y = x + weight\n"
+        "        return y.item()\n"
+    )
+    diags = _lint(bad)
+    assert _rules_of(diags) == ["host-sync"]
+    assert len(diags) == 4  # float(), .asnumpy(), np.asarray, .item()
+    good = (
+        "class M:\n"
+        "    def hybrid_forward(self, F, x, weight):\n"
+        "        return F.relu(x * weight)\n"
+        "    def forward(self, x):\n"
+        "        return float(x.sum())\n"  # eager scope: fine
+    )
+    assert _lint(good) == []
+
+
+def test_tracer_branch_fires_and_clean_twin_silent():
+    bad = (
+        "class M:\n"
+        "    def hybrid_forward(self, F, x):\n"
+        "        if x > 0:\n"
+        "            return x\n"
+        "        y = x * 2\n"
+        "        while y.mean():\n"
+        "            pass\n"
+        "        return y\n"
+    )
+    diags = _lint(bad)
+    assert _rules_of(diags) == ["tracer-branch"]
+    assert len(diags) == 2  # the if, and the while on tainted y
+    # structural branches (None/isinstance/shape) are trace-safe
+    good = (
+        "class M:\n"
+        "    def hybrid_forward(self, F, x, mask=None):\n"
+        "        if mask is None:\n"
+        "            return F.relu(x)\n"
+        "        if not isinstance(x, tuple):\n"
+        "            pass\n"
+        "        if len(x.shape) == 2:\n"
+        "            x = x + 1\n"
+        "        return x * mask\n"
+    )
+    assert _lint(good) == []
+
+
+def test_suppression_comment_silences_rule():
+    bad = "try:\n    pass\nexcept:  # mxlint: disable=bare-except\n    pass\n"
+    assert _lint(bad) == []
+    # a directive for a different rule does not suppress
+    other = "try:\n    pass\nexcept:  # mxlint: disable=host-sync\n    pass\n"
+    assert _rules_of(_lint(other)) == ["bare-except"]
+    # bare `disable` silences everything on the line
+    blanket = "try:\n    pass\nexcept:  # mxlint: disable\n    pass\n"
+    assert _lint(blanket) == []
+
+
+def test_lint_paths_on_repo_is_clean():
+    assert an.lint_paths(["mxnet_tpu", "examples"]) == []
+
+
+# ----------------------------------------------------------------------
+# graph checker
+# ----------------------------------------------------------------------
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_graph_clean_mlp_no_diagnostics():
+    diags = an.check_symbol(_mlp(), shapes={"data": (4, 16),
+                                            "softmax_label": (4,)})
+    assert diags == []
+
+
+def test_graph_duplicate_input():
+    a, b = mx.sym.var("x"), mx.sym.var("x")
+    diags = an.check_symbol(a + b, structural_only=True)
+    assert _rules_of(diags) == ["duplicate-input"]
+    clean = mx.sym.var("x") + mx.sym.var("y")
+    assert an.check_symbol(clean, structural_only=True) == []
+
+
+def test_graph_shape_contradiction():
+    d = mx.sym.var("d", shape=(4, 5))
+    w = mx.sym.var("w", shape=(3, 7))
+    diags = an.check_symbol(mx.sym.dot(d, w))
+    assert "shape-contradiction" in _rules_of(diags)
+    ok = mx.sym.dot(mx.sym.var("a", shape=(4, 5)),
+                    mx.sym.var("b", shape=(5, 7)))
+    assert an.check_symbol(ok) == []
+
+
+def test_graph_unknown_shape_warns():
+    s = mx.sym.var("p") + mx.sym.var("q")
+    diags = an.check_symbol(s)
+    assert _rules_of(diags) == ["unknown-shape"]
+    assert all(d.severity == an.WARNING for d in diags)
+
+
+def test_graph_dtype_promotion_warns():
+    lo = mx.sym.var("lo", shape=(2, 2), dtype="float16")
+    hi = mx.sym.var("hi", shape=(2, 2), dtype="float32")
+    diags = an.check_symbol(lo + hi)
+    assert "dtype-promotion" in _rules_of(diags)
+    assert all(d.severity == an.WARNING for d in diags)
+
+
+def test_graph_unknown_op():
+    from mxnet_tpu.symbol.symbol import Symbol, _Node
+    v = _Node(None, "x", {}, [])
+    bad = _Node("NoSuchOp2077", "bad0", {}, [(v, 0)])
+    diags = an.check_symbol(Symbol([(bad, 0)]), structural_only=True)
+    assert _rules_of(diags) == ["unknown-op"]
+
+
+def test_graph_checker_accepts_model_zoo():
+    """Every vision zoo family + BERT builds a graph the checker
+    accepts (the acceptance bar for later perf/sharding rules)."""
+    from mxnet_tpu.gluon.model_zoo import bert, vision
+    cases = [("resnet18_v1", (1, 3, 224, 224)),
+             ("resnet50_v2", (1, 3, 224, 224)),
+             ("alexnet", (1, 3, 224, 224)),
+             ("vgg11_bn", (1, 3, 224, 224)),
+             ("mobilenet1.0", (1, 3, 224, 224)),
+             ("mobilenetv2_1.0", (1, 3, 224, 224)),
+             ("squeezenet1.0", (1, 3, 224, 224)),
+             ("densenet121", (1, 3, 224, 224)),
+             ("inceptionv3", (1, 3, 299, 299))]
+    for name, shape in cases:
+        net = vision.get_model(name)
+        sym = net(mx.sym.var("data"))
+        if isinstance(sym, (list, tuple)):
+            sym = mx.sym.Group(list(sym))
+        errors = [d for d in an.check_symbol(sym, shapes={"data": shape})
+                  if d.severity == an.ERROR]
+        assert not errors, (name, [d.format() for d in errors])
+
+
+# ----------------------------------------------------------------------
+# bind gate
+# ----------------------------------------------------------------------
+
+def test_executor_gate_raises_on_broken_graph():
+    a, b = mx.sym.var("x"), mx.sym.var("x")
+    with pytest.raises(an.GraphCheckError) as ei:
+        (a + b).simple_bind(grad_req="null", check=True, x=(2, 2))
+    assert "duplicate-input" in str(ei.value)
+    assert isinstance(ei.value, MXNetError)
+
+
+def test_executor_gate_env_var(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_GRAPH_CHECK", "1")
+    a, b = mx.sym.var("x"), mx.sym.var("x")
+    with pytest.raises(an.GraphCheckError):
+        (a + b).simple_bind(grad_req="null", x=(2, 2))
+
+
+def test_executor_gate_clean_bind_runs():
+    ex = _mlp().simple_bind(grad_req="null", check=True, data=(2, 16),
+                            softmax_label=(2,))
+    out = ex.forward()[0]
+    assert out.shape == (2, 4)
+
+
+# ----------------------------------------------------------------------
+# registry error paths (feed the checker's diagnostics)
+# ----------------------------------------------------------------------
+
+def test_get_op_did_you_mean():
+    from mxnet_tpu.ops.registry import get_op
+    with pytest.raises(MXNetError, match="did you mean 'Convolution'"):
+        get_op("Convolutionn")
+    with pytest.raises(MXNetError, match="unknown operator"):
+        get_op("completely_unrelated_zzz")
+
+
+def test_register_rejects_duplicates():
+    from mxnet_tpu.ops.registry import OP_REGISTRY, register
+    with pytest.raises(MXNetError, match="duplicate op registration"):
+        @register("elemwise_add")
+        def _dup(data):
+            return data
+    assert "_dup_alias_probe" not in OP_REGISTRY
+    with pytest.raises(MXNetError, match="duplicate op alias"):
+        @register("_dup_alias_probe", aliases=("elemwise_add",))
+        def _dup2(data):
+            return data
+    # the failed registration must not leave the op name behind
+    OP_REGISTRY.pop("_dup_alias_probe", None)
+
+
+# ----------------------------------------------------------------------
+# retrace auditor
+# ----------------------------------------------------------------------
+
+def test_retrace_audit_clean_and_anchors_present():
+    diags = an.audit_retrace()
+    assert [d.format() for d in diags] == []
+    from mxnet_tpu.analysis.retrace import (cache_key_fields,
+                                            eager_dynamic_params)
+    assert set(cache_key_fields()) >= {"training", "shape", "dtype"}
+    assert "lr" in eager_dynamic_params()
+    # the seed's one real hazard, fixed by threading t dynamically:
+    assert "t" in eager_dynamic_params()
+
+
+def test_retrace_audit_flags_varying_param():
+    from mxnet_tpu.analysis.retrace import _audit_varying_params
+    from mxnet_tpu.ops.registry import OP_REGISTRY, Op, OpParam
+    probe = Op(name="_probe_sched_op", fcompute=lambda data, lr=0.1: data,
+               arg_names=("data",),
+               params=[OpParam("lr", 0.1), OpParam("loss_scale", 1.0)])
+    OP_REGISTRY["_probe_sched_op"] = probe
+    try:
+        diags = [d for d in _audit_varying_params(None)
+                 if d.node == "_probe_sched_op"]
+        # lr is dynamically threaded by the eager engine; loss_scale is not
+        assert len(diags) == 1
+        assert "['loss_scale']" in diags[0].message
+    finally:
+        del OP_REGISTRY["_probe_sched_op"]
+
+
+def test_lamb_t_does_not_recompile():
+    """The hazard the auditor caught in the seed: per-step ``t`` must
+    hit one cached executable, not compile per step."""
+    import numpy as np
+    from mxnet_tpu.ndarray.ndarray import _EAGER_JIT_CACHE
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,))
+    m = mx.nd.zeros((4,))
+    v = mx.nd.zeros((4,))
+    mx.nd.lamb_update_phase1(w, g, m, v, t=1)[0].asnumpy()
+    keys = {k for k in _EAGER_JIT_CACHE if k[0] == "lamb_update_phase1"}
+    for t in (2, 3, 4):
+        mx.nd.lamb_update_phase1(w, g, m, v, t=t)[0].asnumpy()
+    after = {k for k in _EAGER_JIT_CACHE if k[0] == "lamb_update_phase1"}
+    assert keys == after  # no new cache entries => no recompiles
+    # and the math still sees the right t
+    out2 = mx.nd.lamb_update_phase1(w, g, m, v, t=2)[0].asnumpy()
+    out9 = mx.nd.lamb_update_phase1(w, g, m, v, t=9)[0].asnumpy()
+    assert not np.allclose(out2, out9)
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(a=[]):\n    return a\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f(a=None):\n    return a\n")
+
+    rc = an.main([str(good)])
+    assert rc == 0
+    rc = an.main([str(bad)])
+    assert rc == 1
+    rc = an.main([str(bad), "--disable", "mutable-default"])
+    assert rc == 0
+
+
+def test_cli_subprocess_json_contract(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.analysis", str(bad), "--json"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["errors"] == 1
+    assert payload["diagnostics"][0]["rule"] == "bare-except"
+    assert payload["diagnostics"][0]["line"] == 3
+
+
+def test_cli_graph_mode(tmp_path):
+    sym = _mlp()
+    path = tmp_path / "m-symbol.json"
+    sym.save(str(path))
+    rc = an.main(["--graph", str(path), "--shape", "data=2,16",
+                  "--shape", "softmax_label=2"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_cli_self_check_clean():
+    """`ci/run_all.sh lint`'s exact gate: the repo lints itself clean."""
+    rc = an.main(["--self", "--json"])
+    assert rc == 0

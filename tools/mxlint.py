@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Thin launcher for the static-analysis CLI (``mxnet_tpu.analysis``),
+for trees where the ``mxlint`` console script is not installed (CI
+containers running from a source checkout).  Same flags, same exit
+codes: ``python tools/mxlint.py --self --json``."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
