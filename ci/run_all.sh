@@ -4,6 +4,8 @@
 #   lint  -> compile-level sanity over the whole package
 #   suite -> full pytest run (8 virtual CPU devices, same as a PR gate)
 #   examples -> the runnable examples smoke-tested via their test file
+#   telemetry -> 3-step smoke train with the JSONL sink on, then the
+#                summarize CLI must report non-empty step/compile data
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -12,7 +14,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -35,6 +37,52 @@ run_suite() {
 run_examples() {
     log "examples: smoke via tests/test_examples.py"
     python -m pytest tests/test_examples.py -q
+}
+
+run_telemetry() {
+    log "telemetry: 3-step smoke train -> JSONL -> summarize gate"
+    tjsonl=$(mktemp /tmp/mxtpu_telemetry_ci.XXXXXX.jsonl)
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 \
+        MXNET_TPU_TELEMETRY_JSONL="$tjsonl" python - <<'EOF'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+
+net = gluon.nn.Dense(4)
+net.initialize()
+net.hybridize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+ds = gluon.data.ArrayDataset(
+    mx.nd.array(np.random.rand(12, 8).astype(np.float32)),
+    mx.nd.array(np.random.rand(12, 4).astype(np.float32)))
+loader = gluon.data.DataLoader(ds, batch_size=4)
+loss_fn = gluon.loss.L2Loss()
+for x, y in loader:                     # 3 steps
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(4)
+loss.asnumpy()
+telemetry.flush()
+print("smoke train done:", telemetry.counter("trainer.steps").value,
+      "steps")
+EOF
+    # the CLI must exit 0 and report non-empty step/compile sections
+    python -m mxnet_tpu.telemetry summarize "$tjsonl" --json > "$tjsonl.agg"
+    python - "$tjsonl.agg" <<'EOF'
+import json, sys
+agg = json.load(open(sys.argv[1]))
+assert agg["records"] > 0, "empty telemetry log"
+assert agg["steps"]["count"] >= 3, agg["steps"]
+assert agg["compile"]["count"] > 0, agg["compile"]
+assert agg["kvstore"]["bytes"] > 0, agg["kvstore"]
+assert agg["data"]["batches"] >= 3, agg["data"]
+print("telemetry gate ok: %d steps, %d compiles, %d kv bytes"
+      % (agg["steps"]["count"], agg["compile"]["count"],
+         agg["kvstore"]["bytes"]))
+EOF
+    rm -f "$tjsonl" "$tjsonl.agg"
 }
 
 run_bench() {

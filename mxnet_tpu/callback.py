@@ -9,6 +9,7 @@ from __future__ import annotations
 import logging
 import time
 
+from . import telemetry as _telemetry
 from .model import save_checkpoint
 
 
@@ -75,6 +76,10 @@ class Speedometer:
                 except ZeroDivisionError:
                     speed = float("inf")
                 self.last_speed = speed
+                if _telemetry._ENABLED:
+                    # same gauge Trainer.step feeds: Module-API and
+                    # Gluon throughput report through one channel
+                    _telemetry.hooks.samples_per_sec(speed)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     msg = "Epoch[%d] Batch [%d-%d]\tSpeed: %.2f samples/sec"

@@ -105,6 +105,21 @@ _VARS = [
            "with every problem at once (unknown ops, dangling or "
            "duplicate inputs, shape contradictions) before any device "
            "time is spent.  Per-bind override: bind(..., check=True)."),
+    EnvVar("MXNET_TPU_TELEMETRY", bool, False,
+           "'1' enables the runtime telemetry subsystem (mx.telemetry) "
+           "at import: counters/timers/events over op dispatch, "
+           "compile caches, trainer steps, kvstore traffic, the input "
+           "pipeline, AMP, and preemption checkpoints.  Off (the "
+           "default), every hook is a single module-flag check with "
+           "zero instrument calls.  Runtime toggle: "
+           "mx.telemetry.enable()/disable()."),
+    EnvVar("MXNET_TPU_TELEMETRY_JSONL", str, "",
+           "Path of the telemetry JSONL run log.  When set, a JSONL "
+           "sink is attached at import (events and timer samples "
+           "stream; the aggregate snapshot lands at exit or "
+           "mx.telemetry.flush()) -- analyze offline with 'python -m "
+           "mxnet_tpu.telemetry summarize <path>'.  Implies nothing "
+           "about MXNET_TPU_TELEMETRY: set both to record."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -16,9 +16,12 @@ legacy protocol costs a single XLA dispatch per step.  An explicit
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray import NDArray
 from .symbol.symbol import _eval_symbol
@@ -163,7 +166,8 @@ class Executor:
                           if self.grad_req.get(n, "null") != "null"]
             diff = {n: vals[n] for n in grad_names}
             nondiff = {n: v for n, v in vals.items() if n not in diff}
-            if self._train_jit is None:
+            first = self._train_jit is None
+            if first:
                 def _train_step(diff, nondiff, cts):
                     def f(dd):
                         return self._pure({**nondiff, **dd}, True)
@@ -174,17 +178,32 @@ class Executor:
                     (grads,) = vjp(tuple(cts))
                     return outs, aux_up, grads
                 self._train_jit = jax.jit(_train_step)
+            # first call = trace + XLA compile; time it as the compile
+            # event (later calls hit the executable cache)
+            t0 = time.perf_counter() if first and _telemetry._ENABLED \
+                else None
             outs, aux_up, grads = self._train_jit(diff, nondiff, None)
+            if t0 is not None:
+                _telemetry.hooks.compile_event(
+                    "executor.train", seconds=time.perf_counter() - t0,
+                    n_args=len(diff) + len(nondiff))
             for name, v in aux_up.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._data = v
             self._last_train_args = (diff, nondiff)
             self._pending_grads = grads
         else:
-            if self._fwd_jit is None:
+            first = self._fwd_jit is None
+            if first:
                 self._fwd_jit = jax.jit(
                     lambda vals: self._pure(vals, False)[0])
+            t0 = time.perf_counter() if first and _telemetry._ENABLED \
+                else None
             outs = self._fwd_jit(vals)
+            if t0 is not None:
+                _telemetry.hooks.compile_event(
+                    "executor.eval", seconds=time.perf_counter() - t0,
+                    n_args=len(vals))
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 

@@ -38,6 +38,7 @@ import jax
 from .. import autograd
 from .. import ndarray as nd_mod
 from .. import random as _random_mod
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..context import current_context
 from ..ndarray import NDArray
@@ -334,6 +335,38 @@ class Block:
 _CACHE_KEY_STATIC = ("training", "amp_policy", "shape", "dtype")
 
 
+def _cache_key_diff(new_key, old_keys):
+    """Field-labeled diff of a fresh hybridize cache key against the
+    closest existing entry -- the payload of the runtime retrace event
+    (``telemetry.hooks.compile_event``).  Labels follow
+    ``_CACHE_KEY_STATIC`` plus per-argument position, so a log line says
+    e.g. ``changed=['arg0.shape']`` (bucketing) vs ``['training']``
+    (train/eval duality) vs ``['amp_policy']``."""
+    if not old_keys:
+        return []
+    # closest = most leading fields shared
+    def score(k):
+        n = 0
+        for a, b in zip(k, new_key):
+            if a == b:
+                n += 1
+        return n
+    prev = max(old_keys, key=score)
+    changed = []
+    if prev[0] != new_key[0]:
+        changed.append("training")
+    if prev[1] != new_key[1]:
+        changed.append("amp_policy")
+    if len(prev) != len(new_key):
+        changed.append("n_args")
+    for i, (a, b) in enumerate(zip(prev[2:], new_key[2:])):
+        if a[0] != b[0]:
+            changed.append("arg%d.shape" % i)
+        if a[1] != b[1]:
+            changed.append("arg%d.dtype" % i)
+    return changed
+
+
 class _CacheEntry:
     """One compiled specialization of a hybridized block."""
 
@@ -468,7 +501,20 @@ class HybridBlock(Block):
             tuple((a.shape, str(a.dtype)) for a in args)
         entry = self._cached_entries.get(key)
         if entry is None:
-            entry = self._build_cache(args, training)
+            if _telemetry._ENABLED:
+                import time as _time
+                old_keys = list(self._cached_entries)
+                t0 = _time.perf_counter()
+                entry = self._build_cache(args, training)
+                _telemetry.hooks.compile_event(
+                    "hybrid_cache",
+                    seconds=_time.perf_counter() - t0,
+                    retrace=bool(old_keys),
+                    block=type(self).__name__,
+                    cache_size=len(old_keys) + 1,
+                    changed=_cache_key_diff(key, old_keys))
+            else:
+                entry = self._build_cache(args, training)
             self._cached_entries[key] = entry
         import contextlib
         from .. import profiler as _profiler

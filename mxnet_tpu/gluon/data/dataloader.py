@@ -17,6 +17,7 @@ import time
 
 import numpy as np
 
+from ... import telemetry as _telemetry
 from ...base import MXNetError
 from ...ndarray import NDArray, array
 from .dataset import Dataset
@@ -67,6 +68,23 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        it = self._iter_impl()
+        if not _telemetry._ENABLED:
+            yield from it
+            return
+        # starvation probe: time the consumer spends waiting on each
+        # batch.  When data.wait_time rivals trainer.step_time, the
+        # input pipeline -- not the device -- is the bottleneck.
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            _telemetry.hooks.dataloader_wait(time.perf_counter() - t0)
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

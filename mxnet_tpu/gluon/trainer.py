@@ -7,7 +7,10 @@ update, preserving the reference's ``update_on_kvstore`` semantics.
 """
 from __future__ import annotations
 
+import time
+
 from .. import optimizer as opt
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
 
@@ -102,6 +105,15 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce (via kvstore/collectives) + optimizer update
         (reference: ``Trainer.step``)."""
+        t0 = time.perf_counter() if _telemetry._ENABLED else None
+        try:
+            self._step_impl(batch_size, ignore_stale_grad)
+        finally:
+            if t0 is not None:
+                _telemetry.hooks.trainer_step(
+                    time.perf_counter() - t0, batch_size)
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size

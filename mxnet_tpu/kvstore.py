@@ -30,12 +30,14 @@ Design (SURVEY.md §5 "Distributed communication backend"):
 from __future__ import annotations
 
 import pickle
+import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray import sparse as _sp
@@ -52,6 +54,20 @@ def _allreduce_across_processes(x):
     if world()[0] == 1:
         return x
     return host_allreduce(x, average=False)
+
+
+def _value_nbytes(value):
+    """Payload size of a pushed/pulled value from shape/dtype metadata
+    only -- never forces a device sync.  Lists sum; sparse and exotic
+    values degrade to 0 rather than sync or raise."""
+    if isinstance(value, (list, tuple)):
+        return sum(_value_nbytes(v) for v in value)
+    try:
+        shape, dtype = value.shape, value.dtype
+        return int(np.prod(shape)) * np.dtype(dtype).itemsize \
+            if shape else np.dtype(dtype).itemsize
+    except Exception:
+        return 0
 
 
 class _TwoBitCompression:
@@ -154,6 +170,8 @@ class KVStore:
         key = self._keyify(key)
         if key not in self._store:
             raise MXNetError("kvstore key %r not initialized" % key)
+        if _telemetry._ENABLED:
+            _telemetry.hooks.kv_op("push", _value_nbytes(value))
         merged, sparse_grad = self._reduce_for_update(key, value)
         if self._updater is not None:
             grad = merged if sparse_grad else NDArray(merged)
@@ -185,6 +203,8 @@ class KVStore:
         key = self._keyify(key)
         if key not in self._store:
             raise MXNetError("kvstore key %r not initialized" % key)
+        if _telemetry._ENABLED:
+            _telemetry.hooks.kv_op("pull", _value_nbytes(self._store[key]))
         pending = getattr(self, "_pending", {})
         if self._updater is None and key in pending:
             src = pending.pop(key)
@@ -209,6 +229,9 @@ class KVStore:
                 self.pushpull(k, v, o, priority)
             return
         key = self._keyify(key)
+        # allreduce wall time is DISPATCH time under async XLA; the
+        # reduce itself overlaps compute and only lands at a sync point
+        t0 = time.perf_counter() if _telemetry._ENABLED else None
         merged, sparse_grad = self._reduce_for_update(key, value)
         if self._updater is not None:
             if key not in self._store:
@@ -218,6 +241,9 @@ class KVStore:
             result = self._store[key]._data
         else:
             result = merged.todense()._data if sparse_grad else merged
+        if t0 is not None:
+            _telemetry.hooks.kv_op("pushpull", _value_nbytes(value),
+                                   time.perf_counter() - t0)
         if out is not None:
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from .. import autograd
 from .. import random as _random_mod
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ops.registry import Op, get_op
@@ -59,6 +60,8 @@ def waitall():
     failed cannot be resurrected, but every live array's pending work is
     drained and the first failure propagates.
     """
+    if _telemetry._ENABLED:
+        _telemetry.hooks.host_sync("waitall")
     bulk.flush()
     if hasattr(jax, "effects_barrier"):
         jax.effects_barrier()
@@ -163,6 +166,8 @@ class NDArray:
     # -- sync / conversion --------------------------------------------
     def asnumpy(self):
         """Blocking copy to host (reference: ``MXNDArraySyncCopyToCPU``)."""
+        if _telemetry._ENABLED:
+            _telemetry.hooks.host_sync("asnumpy")
         return np.asarray(self._data)
 
     def __array__(self, dtype=None, copy=None):
@@ -206,6 +211,8 @@ class NDArray:
         return self.shape[0]
 
     def wait_to_read(self):
+        if _telemetry._ENABLED:
+            _telemetry.hooks.host_sync("wait_to_read")
         if not _is_traced(self._data):
             self._data.block_until_ready()
 
@@ -673,7 +680,33 @@ def _eager_jit_fn(op, params, present, total_args):
 
         entry = (jax.jit(f), f, stateful)
         _EAGER_JIT_CACHE[sig] = entry
+        if _telemetry._ENABLED:
+            _emit_eager_compile(sig)
     return entry[0], dyn_names, sig
+
+
+def _emit_eager_compile(sig):
+    """A fresh eager-dispatch cache entry was created: emit a compile
+    event.  When the op already holds a same-arity entry, this is a
+    RETRACE -- a static param (or the amp policy) changed value, the
+    exact class of recompile-per-step regression the static auditor
+    flagged for LAMB's ``t`` -- and the payload names the params that
+    differ so the log says *why* XLA compiled again."""
+    opname, present, total, psig, _dyn, amp_token = sig
+    prior = [s for s in _EAGER_JIT_CACHE
+             if s[0] == opname and s[1] == present and s[2] == total
+             and s is not sig]
+    changed = []
+    if prior:
+        prev = prior[-1]
+        prev_ps, cur_ps = dict(prev[3]), dict(psig)
+        changed = sorted(str(k) for k in set(prev_ps) | set(cur_ps)
+                         if prev_ps.get(k) != cur_ps.get(k))
+        if prev[5] != amp_token:
+            changed.append("amp_policy")
+    _telemetry.hooks.compile_event(
+        "eager_jit", retrace=bool(prior), op=opname,
+        cache_size=len(_EAGER_JIT_CACHE), changed=changed)
 
 
 # Per-sig cached BACKWARD executables for recorded eager ops.  Without
@@ -711,6 +744,8 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
     """Dispatch one op eagerly (reference: ``Imperative::Invoke`` in
     ``src/imperative/imperative.cc``; shape/type inference + engine push
     collapse into a single traced JAX call here)."""
+    if _telemetry._ENABLED:
+        _telemetry.hooks.op_dispatch(op.name)
     kwargs = dict(kwargs)
     kwargs.pop("name", None)
     params = op.param_defaults()

@@ -22,6 +22,7 @@ import os
 import signal
 import threading
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["PreemptionHandler", "install", "resume"]
@@ -154,6 +155,11 @@ class PreemptionHandler:
                     self._fallback_saved = True
                 else:
                     self.saved = True
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.checkpoint(
+                        "save", prefix=self.prefix, step=step,
+                        provisional=bool(provisional),
+                        signal_triggered=self._signal_seen)
             finally:
                 self._saving = False
 
@@ -221,4 +227,8 @@ def resume(prefix, block, trainer=None, ctx=None):
     if trainer is not None and os.path.exists(states):
         trainer.load_states(states)
     with open(meta_path) as f:
-        return json.load(f)
+        meta = json.load(f)
+    if _telemetry._ENABLED:
+        _telemetry.hooks.checkpoint("restore", prefix=prefix,
+                                    step=meta.get("step"))
+    return meta

@@ -191,33 +191,49 @@ class Event(_NamedRegion):
     """Reference: ``profiler.Event``."""
 
 
+# profiler counters live in the telemetry registry under this prefix,
+# so they show up in telemetry sinks/snapshots and ``profiler.reset()``
+# can clear exactly them
+_COUNTER_PREFIX = "profiler."
+
+
 class Counter:
     """Named counter (reference: ``profiler.Counter(domain, name,
-    value)``).  Values are kept host-side; re-constructing an existing
-    name attaches to it without resetting."""
+    value)``).  Re-constructing an existing name attaches to it without
+    resetting (reference semantics).
 
-    _counters = {}
+    Backed by the ``mx.telemetry`` registry (one store, visible in every
+    telemetry sink) instead of the former class-global dict, which
+    leaked values across instances AND across tests with no way to
+    clear them; ``profiler.reset()`` now zeroes all profiler counters.
+    """
 
     def __init__(self, domain_or_name, name=None, value=None):
+        from . import telemetry
         self.name = _region_name(domain_or_name, name)
+        self._counter = telemetry.counter(_COUNTER_PREFIX + self.name)
         if value is not None:
-            Counter._counters[self.name] = value
-        else:
-            Counter._counters.setdefault(self.name, 0)
+            self._counter.set(value)
 
     def set_value(self, value):
-        Counter._counters[self.name] = value
+        self._counter.set(value)
 
     def increment(self, delta=1):
-        Counter._counters[self.name] = \
-            Counter._counters.get(self.name, 0) + delta
+        self._counter.inc(delta)
 
     def decrement(self, delta=1):
-        self.increment(-delta)
+        self._counter.dec(delta)
 
     @property
     def value(self):
-        return Counter._counters.get(self.name, 0)
+        return self._counter.value
+
+
+def reset():
+    """Zero every ``profiler.Counter`` (test isolation; the former
+    class-global dict had no reset and leaked across tests)."""
+    from . import telemetry
+    telemetry.reset(prefix=_COUNTER_PREFIX)
 
 
 def marker(name, scope="process"):

@@ -40,8 +40,17 @@ def _detect():
         "DIST_KVSTORE": True,   # jax.distributed + collectives
         "OPENMP": False,
         "F16C": True,
+        # runtime telemetry subsystem (mx.telemetry): reports the LIVE
+        # enable state, so feature_list() answers "is this run
+        # instrumented" rather than "was it compiled in"
+        "TELEMETRY": _telemetry_enabled(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
+
+
+def _telemetry_enabled():
+    from . import telemetry
+    return telemetry.enabled()
 
 
 def _try_import(mod):

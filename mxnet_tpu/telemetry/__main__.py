@@ -1,0 +1,6 @@
+"""``python -m mxnet_tpu.telemetry`` -> the telemetry CLI."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

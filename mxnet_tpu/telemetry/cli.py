@@ -1,0 +1,220 @@
+"""``python -m mxnet_tpu.telemetry`` -- offline analysis of telemetry
+JSONL run logs.
+
+Contract mirrors the mxlint CLI (``mxnet_tpu.analysis.cli``): exit 0 on
+success with ``--json`` for machine-readable output, exit 1 when the log
+is missing/empty (nothing to summarize is a failed gate in CI), exit 2
+on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .sinks import prom_text, summary_table
+
+__all__ = ["main", "summarize_file"]
+
+
+def _build_parser():
+    ap = argparse.ArgumentParser(
+        prog="python -m mxnet_tpu.telemetry",
+        description="Summarize a telemetry JSONL run log "
+                    "(docs/observability.md).")
+    sub = ap.add_subparsers(dest="cmd")
+    sm = sub.add_parser("summarize", help="aggregate a run.jsonl")
+    sm.add_argument("path", help="telemetry JSONL file "
+                                 "(MXNET_TPU_TELEMETRY_JSONL)")
+    sm.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable aggregate")
+    sm.add_argument("--prom", action="store_true",
+                    help="Prometheus text exposition instead of the "
+                         "console table")
+    return ap
+
+
+def summarize_file(path):
+    """Aggregate one JSONL run log into a dict.
+
+    Streamed ``event``/``sample`` records are folded per name; trailing
+    ``snapshot.*`` records (written by ``telemetry.flush()``) win over
+    the folds for the instruments they cover, since they carry the
+    authoritative counts.  Returns the aggregate; raises OSError when
+    the file cannot be read.
+    """
+    counters, gauges, timers, events = {}, {}, {}, {}
+    sample_folds = {}
+    event_folds = {}
+    records = skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                kind = rec["kind"]
+                name = rec["name"]
+            except (ValueError, KeyError, TypeError):
+                skipped += 1
+                continue
+            records += 1
+            if kind == "sample":
+                agg = sample_folds.setdefault(
+                    name, {"count": 0, "sum": 0.0, "min": None,
+                           "max": None})
+                v = float(rec.get("value", 0.0))
+                agg["count"] += 1
+                agg["sum"] += v
+                agg["min"] = v if agg["min"] is None else min(agg["min"], v)
+                agg["max"] = v if agg["max"] is None else max(agg["max"], v)
+            elif kind == "event":
+                agg = event_folds.setdefault(
+                    name, {"count": 0, "last_payload": None})
+                agg["count"] += 1
+                agg["last_payload"] = rec.get("payload")
+            elif kind == "snapshot.counter":
+                counters[name] = rec.get("value", 0)
+            elif kind == "snapshot.gauge":
+                if rec.get("value") is not None:
+                    gauges[name] = {k: rec.get(k) for k in
+                                    ("value", "count", "min", "max")}
+            elif kind == "snapshot.timer":
+                timers[name] = {k: rec.get(k) for k in
+                                ("count", "sum", "min", "max", "mean")}
+            elif kind == "snapshot.event":
+                events[name] = {"count": rec.get("count", 0),
+                                "last_payload": rec.get("last_payload")}
+            else:
+                skipped += 1
+    # streamed folds fill in anything the final snapshot missed (e.g. a
+    # run killed before flush)
+    for name, agg in sample_folds.items():
+        if name not in timers:
+            timers[name] = {**agg, "mean": (agg["sum"] / agg["count"])
+                            if agg["count"] else None}
+    for name, agg in event_folds.items():
+        if name not in events:
+            events[name] = agg
+
+    step = timers.get("trainer.step_time", {})
+    spsec = gauges.get("trainer.samples_per_sec", {})
+    compile_ev = events.get("compile", {})
+    result = {
+        "file": path,
+        "records": records,
+        "skipped": skipped,
+        "counters": counters,
+        "gauges": gauges,
+        "timers": timers,
+        "events": events,
+        "steps": {
+            "count": step.get("count", 0),
+            "total_s": step.get("sum"),
+            "mean_s": step.get("mean"),
+            "samples": counters.get("trainer.samples", 0),
+            "samples_per_sec": spsec.get("value"),
+        },
+        "compile": {
+            "count": counters.get("compile.count",
+                                  compile_ev.get("count", 0)),
+            "retraces": counters.get("compile.retraces", 0),
+            "build_time_s": timers.get("compile.build_time",
+                                       {}).get("sum"),
+            "last": compile_ev.get("last_payload"),
+        },
+        "kvstore": {
+            "pushpull": counters.get("kvstore.pushpull", 0),
+            "push": counters.get("kvstore.push", 0),
+            "pull": counters.get("kvstore.pull", 0),
+            "bytes": counters.get("kvstore.bytes", 0),
+            "time_s": timers.get("kvstore.time", {}).get("sum"),
+        },
+        "data": {
+            "batches": counters.get("data.batches", 0),
+            "wait_s": timers.get("data.wait_time", {}).get("sum"),
+            "mean_wait_s": timers.get("data.wait_time", {}).get("mean"),
+        },
+    }
+    return result
+
+
+def _to_snapshot(agg):
+    """Rebuild a Registry.snapshot()-shaped list from an aggregate so
+    the offline CLI reuses the live renderers."""
+    snap = []
+    for name, value in sorted(agg["counters"].items()):
+        snap.append({"kind": "counter", "name": name, "value": value})
+    for name, g in sorted(agg["gauges"].items()):
+        snap.append({"kind": "gauge", "name": name, **g})
+    for name, t in sorted(agg["timers"].items()):
+        snap.append({"kind": "timer", "name": name, "buckets": {}, **t})
+    for name, e in sorted(agg["events"].items()):
+        snap.append({"kind": "event", "name": name, **e})
+    return snap
+
+
+def _render_human(agg):
+    lines = ["telemetry summary: %s (%d records)"
+             % (agg["file"], agg["records"]), ""]
+    st = agg["steps"]
+    if st["count"]:
+        sps = st["samples_per_sec"]
+        lines.append(
+            "  steps: %d in %.3fs (mean %.1fms)%s"
+            % (st["count"], st["total_s"] or 0.0,
+               1e3 * (st["mean_s"] or 0.0),
+               ", %.1f samples/sec" % sps if sps else ""))
+    cp = agg["compile"]
+    if cp["count"]:
+        lines.append("  compiles: %d (%d retraces, %.3fs building)"
+                     % (cp["count"], cp["retraces"],
+                        cp["build_time_s"] or 0.0))
+    kv = agg["kvstore"]
+    if kv["pushpull"] or kv["push"] or kv["pull"]:
+        lines.append("  kvstore: %d pushpull / %d push / %d pull, "
+                     "%d bytes" % (kv["pushpull"], kv["push"],
+                                   kv["pull"], kv["bytes"]))
+    da = agg["data"]
+    if da["batches"]:
+        lines.append("  input: %d batches, %.3fs waiting (mean %.1fms)"
+                     % (da["batches"], da["wait_s"] or 0.0,
+                        1e3 * (da["mean_wait_s"] or 0.0)))
+    lines.append("")
+    lines.append(summary_table(_to_snapshot(agg)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = _build_parser()
+    args = ap.parse_args(argv)
+    if args.cmd != "summarize":
+        ap.print_usage()
+        return 2
+    try:
+        agg = summarize_file(args.path)
+    except OSError as e:
+        print("cannot read %s: %s" % (args.path, e), file=sys.stderr)
+        return 1
+    if not agg["records"]:
+        print("no telemetry records in %s" % args.path, file=sys.stderr)
+        return 1
+    try:
+        if args.as_json:
+            print(json.dumps(agg, indent=2, sort_keys=True))
+        elif args.prom:
+            print(prom_text(_to_snapshot(agg)), end="")
+        else:
+            print(_render_human(agg))
+    except BrokenPipeError:
+        # downstream pager/head closed early: that's a success, not a
+        # stack trace.  Point stdout at devnull so interpreter teardown
+        # doesn't re-raise on the final flush.
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

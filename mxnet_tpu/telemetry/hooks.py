@@ -1,0 +1,143 @@
+"""Hot-path recording helpers.
+
+Every instrumented framework module (ndarray dispatch, executor, gluon
+block/trainer, kvstore, dataloader, amp, preemption, callback) guards a
+single call into this module with the one module-level flag check::
+
+    if _telemetry._ENABLED:
+        _telemetry.hooks.op_dispatch(op.name)
+
+Keeping the recording logic here (instead of inline at each hook point)
+means the hot modules carry exactly one branch when telemetry is off --
+the zero-overhead contract tests/test_telemetry.py proves by counting
+calls into this module -- and the instrument naming stays in one place.
+
+Instrument naming (see docs/observability.md):
+
+=====================  ======  =========================================
+name                   kind    meaning
+=====================  ======  =========================================
+dispatch.op_calls      counter imperative op invocations (total)
+dispatch.op.<op>       counter per-op invocation count
+dispatch.host_sync     counter host sync points (asnumpy/wait/waitall)
+dispatch.host_sync.<k> counter per-kind sync count
+compile                event   one per XLA trace/compile, payload says
+                               where and why (cache-key diff on retrace)
+compile.count          counter total compiles
+compile.retraces       counter compiles that REPLACED warm cache state
+compile.build_time     timer   wall time spent tracing/compiling
+trainer.step_time      timer   Trainer.step wall time
+trainer.steps          counter optimizer steps taken
+trainer.samples        counter samples pushed through step()
+trainer.samples_per_sec gauge  throughput (Trainer.step + Speedometer)
+kvstore.push/pull/
+  pushpull             counter kvstore calls by verb
+kvstore.bytes          counter gradient bytes moved through kvstore
+kvstore.time           timer   wall time in pushpull (dispatch side)
+data.batches           counter batches produced by DataLoader
+data.wait_time         timer   consumer wait per batch (input
+                               starvation when this rivals step_time)
+amp.overflow           event   fp16 grad overflow (scale halved)
+amp.overflows          counter total overflow steps
+amp.rescale            event   loss-scale growth after a clean window
+amp.loss_scale         gauge   current loss scale
+checkpoint             event   preemption checkpoint save/restore
+checkpoint.saves       counter saves (incl. provisional)
+checkpoint.restores    counter resumes from a preemption checkpoint
+=====================  ======  =========================================
+"""
+from __future__ import annotations
+
+__all__ = [
+    "op_dispatch", "host_sync", "compile_event", "trainer_step",
+    "samples_per_sec", "kv_op", "dataloader_wait", "amp_overflow",
+    "amp_rescale", "checkpoint",
+]
+
+
+def _registry():
+    # late import: telemetry/__init__ rebinds the module-global registry
+    # on reset; resolving through the package keeps hooks working
+    from . import _registry
+    return _registry
+
+
+def op_dispatch(opname):
+    reg = _registry()
+    reg.counter("dispatch.op_calls").inc()
+    reg.counter("dispatch.op." + opname).inc()
+
+
+def host_sync(kind):
+    reg = _registry()
+    reg.counter("dispatch.host_sync").inc()
+    reg.counter("dispatch.host_sync." + kind).inc()
+
+
+def compile_event(site, seconds=None, retrace=False, **payload):
+    """One XLA trace/compile happened at ``site`` (``hybrid_cache``,
+    ``executor.train``, ``executor.eval``, ``eager_jit``).  ``retrace``
+    marks a compile that joined a non-empty cache -- the runtime analog
+    of the static retrace auditor's findings; ``payload`` carries the
+    cache-key diff."""
+    reg = _registry()
+    reg.counter("compile.count").inc()
+    if retrace:
+        reg.counter("compile.retraces").inc()
+    if seconds is not None:
+        reg.timer("compile.build_time").observe(seconds, site=site)
+    reg.event("compile").emit(site=site, retrace=bool(retrace),
+                              seconds=seconds, **payload)
+
+
+def trainer_step(seconds, batch_size):
+    reg = _registry()
+    reg.timer("trainer.step_time").observe(seconds)
+    reg.counter("trainer.steps").inc()
+    if batch_size:
+        reg.counter("trainer.samples").inc(int(batch_size))
+        if seconds > 0:
+            reg.gauge("trainer.samples_per_sec").set(batch_size / seconds)
+
+
+def samples_per_sec(value):
+    """Throughput reported by an outer logger (callback.Speedometer):
+    same gauge the Trainer feeds, so Module-API and Gluon training
+    report through one channel."""
+    _registry().gauge("trainer.samples_per_sec").set(value)
+
+
+def kv_op(verb, nbytes, seconds=None):
+    reg = _registry()
+    reg.counter("kvstore." + verb).inc()
+    if nbytes:
+        reg.counter("kvstore.bytes").inc(int(nbytes))
+    if seconds is not None:
+        reg.timer("kvstore.time").observe(seconds, verb=verb)
+
+
+def dataloader_wait(seconds):
+    reg = _registry()
+    reg.counter("data.batches").inc()
+    reg.timer("data.wait_time").observe(seconds)
+
+
+def amp_overflow(scale_before, scale_after):
+    reg = _registry()
+    reg.counter("amp.overflows").inc()
+    reg.gauge("amp.loss_scale").set(scale_after)
+    reg.event("amp.overflow").emit(scale_before=scale_before,
+                                   scale_after=scale_after)
+
+
+def amp_rescale(scale_before, scale_after):
+    reg = _registry()
+    reg.gauge("amp.loss_scale").set(scale_after)
+    reg.event("amp.rescale").emit(scale_before=scale_before,
+                                  scale_after=scale_after)
+
+
+def checkpoint(action, **payload):
+    reg = _registry()
+    reg.counter("checkpoint.%ss" % action).inc()
+    reg.event("checkpoint").emit(action=action, **payload)

@@ -129,3 +129,40 @@ def test_profiler_custom_objects(tmp_path):
     c.increment(2)
     assert c.value == 3
     mx.profiler.stop()
+    mx.profiler.reset()
+
+
+def test_profiler_counter_reset_and_registry_backing():
+    """The former class-global ``Counter._counters`` dict leaked values
+    across instances and tests; counters are now backed by the
+    telemetry registry and ``profiler.reset()`` zeroes them."""
+    from mxnet_tpu import telemetry
+    c1 = mx.profiler.Counter("reset_check")
+    c1.increment(5)
+    # attach semantics preserved (reference behavior): same name, no
+    # value argument -> attaches without resetting
+    c2 = mx.profiler.Counter("reset_check")
+    assert c2.value == 5
+    c2.decrement(2)
+    assert c1.value == 3
+    # explicit value argument resets (reference behavior)
+    c3 = mx.profiler.Counter("reset_check", value=10)
+    assert c1.value == 10 and c3.value == 10
+    # visible through the telemetry registry (one store, all sinks)
+    assert telemetry.registry().get("profiler.reset_check").value == 10
+    mx.profiler.reset()
+    assert c1.value == 0 and c2.value == 0 and c3.value == 0
+    # reset scopes to profiler counters only
+    telemetry.counter("not_profiler").inc(4)
+    mx.profiler.reset()
+    assert telemetry.counter("not_profiler").value == 4
+    telemetry.registry().clear("not_profiler")
+
+
+def test_profiler_counter_domain_naming():
+    d = mx.profiler.Domain("io")
+    c = mx.profiler.Counter(d, "reads", value=2)
+    assert c.name == "io::reads"
+    c.increment()
+    assert mx.profiler.Counter(d, "reads").value == 3
+    mx.profiler.reset()

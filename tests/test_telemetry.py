@@ -1,0 +1,493 @@
+"""Runtime telemetry subsystem tests (ISSUE 2): instrument semantics,
+the disabled-mode zero-instrument-call contract, sink round-trips, the
+summarize CLI exit-code contract, and the runtime retrace counter that
+catches LAMB-style recompiles the static auditor can't see."""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+from mxnet_tpu.telemetry import cli as tcli
+from mxnet_tpu.telemetry import hooks as thooks
+from mxnet_tpu.telemetry.core import Registry
+from mxnet_tpu.telemetry.sinks import prom_text, summary_table
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Each test starts disabled with an empty registry and leaves the
+    process the same way (telemetry state is global by design)."""
+    telemetry.disable()
+    telemetry.registry().clear()
+    yield
+    telemetry.disable()
+    if telemetry._jsonl_sink is not None:
+        telemetry.registry().detach(telemetry._jsonl_sink)
+        telemetry._jsonl_sink.close()
+        telemetry._jsonl_sink = None
+    telemetry.registry().clear()
+
+
+# ---------------------------------------------------------------------
+# instrument semantics
+# ---------------------------------------------------------------------
+
+def test_counter_gauge_semantics():
+    reg = Registry()
+    c = reg.counter("c")
+    c.inc()
+    c.inc(4)
+    c.dec()
+    assert c.value == 4
+    assert reg.counter("c") is c          # get-or-create attaches
+    g = reg.gauge("g")
+    g.set(2.0)
+    g.set(0.5)
+    g.set(1.0)
+    snap = g.snapshot()
+    assert snap["value"] == 1.0 and snap["min"] == 0.5 \
+        and snap["max"] == 2.0 and snap["count"] == 3
+
+
+def test_timer_histogram_and_context():
+    reg = Registry()
+    t = reg.timer("t")
+    t.observe(0.010)
+    t.observe(0.002)
+    with t.time():
+        pass
+    snap = t.snapshot()
+    assert snap["count"] == 3
+    assert snap["min"] <= 0.002 and snap["max"] >= 0.010
+    assert abs(snap["sum"] - (snap["mean"] * 3)) < 1e-9
+    assert sum(snap["buckets"].values()) == 3
+
+
+def test_event_ring_and_payload():
+    reg = Registry()
+    e = reg.event("e")
+    for i in range(300):
+        e.emit(i=i)
+    assert e.count == 300
+    assert len(e.recent) == 256           # bounded ring
+    assert e.recent[-1] == {"i": 299}
+    assert e.snapshot()["last_payload"] == {"i": 299}
+
+
+def test_kind_conflict_raises():
+    reg = Registry()
+    reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.timer("x")
+
+
+def test_registry_thread_safety():
+    reg = Registry()
+    c = reg.counter("n")
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            reg.timer("t").observe(1e-6)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert reg.timer("t").count == 8000
+
+
+def test_reset_and_prefix_reset():
+    reg = Registry()
+    reg.counter("a.x").inc(3)
+    reg.counter("b.y").inc(5)
+    reg.reset(prefix="a.")
+    assert reg.counter("a.x").value == 0
+    assert reg.counter("b.y").value == 5
+    reg.reset()
+    assert reg.counter("b.y").value == 0
+
+
+# ---------------------------------------------------------------------
+# disabled-mode contract: hot paths make ZERO instrument calls
+# ---------------------------------------------------------------------
+
+def _exercise_hot_paths():
+    """Touch every instrumented path once: imperative dispatch, host
+    syncs, hybrid cache, trainer step, kvstore, dataloader, amp."""
+    x = mx.nd.ones((4, 5))
+    y = x * 2 + 1
+    y.asnumpy()
+    y.wait_to_read()
+    mx.nd.waitall()
+
+    net = gluon.nn.Dense(3, in_units=5)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4)
+
+    ds = gluon.data.ArrayDataset(mx.nd.ones((4, 2)), mx.nd.ones((4,)))
+    for _batch in gluon.data.DataLoader(ds, batch_size=2):
+        pass
+
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((3,)))
+    out = mx.nd.zeros((3,))
+    kv.push("w", mx.nd.ones((3,)))
+    kv.pull("w", out=out)
+    kv.pushpull("w", mx.nd.ones((3,)), out=out)
+
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    sc = LossScaler(scale_window=1)
+    sc.update_scale(overflow=True)
+    sc.update_scale(overflow=False)
+
+
+def test_disabled_mode_makes_zero_instrument_calls(monkeypatch):
+    """The acceptance-criteria proof: with telemetry off, the hot-path
+    hooks are never entered -- each instrumented site costs exactly its
+    one module-flag check."""
+    calls = []
+    for name in thooks.__all__:
+        orig = getattr(thooks, name)
+
+        def counted(*a, _name=name, _orig=orig, **kw):
+            calls.append(_name)
+            return _orig(*a, **kw)
+
+        monkeypatch.setattr(thooks, name, counted)
+
+    assert not telemetry.enabled()
+    _exercise_hot_paths()
+    assert calls == [], "hooks fired while telemetry disabled: %r" % calls
+
+    telemetry.enable()
+    _exercise_hot_paths()
+    fired = set(calls)
+    assert {"op_dispatch", "host_sync", "trainer_step", "kv_op",
+            "dataloader_wait", "amp_overflow", "amp_rescale"} <= fired, \
+        "expected hooks missing: fired=%r" % sorted(fired)
+
+
+def test_enable_disable_and_feature_row():
+    assert not telemetry.enabled()
+    feats = mx.runtime.Features()
+    assert "TELEMETRY" in feats
+    assert not feats.is_enabled("TELEMETRY")
+    telemetry.enable()
+    assert mx.runtime.Features().is_enabled("TELEMETRY")
+    assert any(f.name == "TELEMETRY" and f.enabled
+               for f in mx.runtime.feature_list())
+    telemetry.disable()
+    assert not mx.runtime.Features().is_enabled("TELEMETRY")
+
+
+# ---------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------
+
+def test_jsonl_sink_round_trip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    telemetry.enable()
+    telemetry.attach_jsonl(path)
+    try:
+        telemetry.counter("demo.count").inc(7)
+        telemetry.gauge("demo.gauge").set(1.5)
+        telemetry.timer("demo.timer").observe(0.25)
+        telemetry.event("demo.event").emit(reason="test", n=1)
+        telemetry.flush()
+    finally:
+        telemetry._jsonl_sink.close()
+    records = [json.loads(line) for line in open(path)]
+    kinds = {r["kind"] for r in records}
+    # streamed records AND the flush snapshot
+    assert {"sample", "event", "snapshot.counter", "snapshot.gauge",
+            "snapshot.timer", "snapshot.event"} <= kinds
+    agg = tcli.summarize_file(path)
+    assert agg["counters"]["demo.count"] == 7
+    assert agg["gauges"]["demo.gauge"]["value"] == 1.5
+    assert agg["timers"]["demo.timer"]["count"] == 1
+    assert agg["events"]["demo.event"]["last_payload"] == \
+        {"reason": "test", "n": 1}
+
+
+def test_jsonl_survives_unflushed_run(tmp_path):
+    """A run killed before flush still yields a usable summary from the
+    streamed event/sample records alone."""
+    path = str(tmp_path / "run.jsonl")
+    telemetry.enable()
+    telemetry.attach_jsonl(path)
+    telemetry.timer("trainer.step_time").observe(0.05)
+    telemetry.event("compile").emit(site="hybrid_cache", retrace=False)
+    telemetry._jsonl_sink.flush()   # file write only, no snapshot
+    agg = tcli.summarize_file(path)
+    telemetry._jsonl_sink.close()
+    assert agg["steps"]["count"] == 1
+    assert agg["compile"]["count"] == 1
+
+
+def test_prom_exposition_format():
+    telemetry.counter("a.calls").inc(3)
+    telemetry.gauge("a.speed").set(12.5)
+    telemetry.timer("a.lat").observe(0.002)
+    telemetry.event("a.ev").emit(k=1)
+    text = telemetry.prom_dump()
+    assert "# TYPE mxnet_tpu_a_calls counter" in text
+    assert "mxnet_tpu_a_calls 3" in text
+    assert "mxnet_tpu_a_speed 12.5" in text
+    assert "mxnet_tpu_a_lat_count 1" in text
+    assert 'mxnet_tpu_a_lat_bucket{le="+Inf"} 1' in text
+    assert "mxnet_tpu_a_ev 1" in text
+
+
+def test_prom_dump_to_file(tmp_path):
+    telemetry.counter("z").inc()
+    p = tmp_path / "metrics.prom"
+    text = telemetry.prom_dump(str(p))
+    assert p.read_text() == text
+
+
+def test_console_summary_table():
+    telemetry.counter("c1").inc(2)
+    telemetry.timer("t1").observe(0.5)
+    table = telemetry.summary()
+    assert "counters" in table and "c1" in table
+    assert "timers" in table and "t1" in table
+    # empty registry renders, not crashes
+    assert "no telemetry" in summary_table([])
+    assert prom_text([]) == ""
+
+
+# ---------------------------------------------------------------------
+# CLI contract (mirrors the mxlint contract: 0 ok / 1 nothing / 2 usage)
+# ---------------------------------------------------------------------
+
+def _write_demo_log(path):
+    telemetry.enable()
+    telemetry.attach_jsonl(str(path))
+    telemetry.timer("trainer.step_time").observe(0.01)
+    telemetry.counter("trainer.samples").inc(8)
+    telemetry.event("compile").emit(site="eager_jit", retrace=False)
+    telemetry.counter("compile.count").inc()
+    telemetry.flush()
+    telemetry._jsonl_sink.close()
+
+
+def test_cli_json_exit_code_contract(tmp_path, capsys):
+    log = tmp_path / "run.jsonl"
+    _write_demo_log(log)
+    rc = tcli.main(["summarize", str(log), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    agg = json.loads(out)
+    assert agg["steps"]["count"] == 1
+    assert agg["compile"]["count"] == 1
+    assert agg["records"] > 0
+
+
+def test_cli_human_and_prom_render(tmp_path, capsys):
+    log = tmp_path / "run.jsonl"
+    _write_demo_log(log)
+    assert tcli.main(["summarize", str(log)]) == 0
+    human = capsys.readouterr().out
+    assert "telemetry summary" in human and "steps: 1" in human
+    assert tcli.main(["summarize", str(log), "--prom"]) == 0
+    prom = capsys.readouterr().out
+    assert "mxnet_tpu_trainer_step_time_count 1" in prom
+
+
+def test_cli_empty_and_missing_exit_1(tmp_path, capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert tcli.main(["summarize", str(empty)]) == 1
+    assert tcli.main(["summarize", str(tmp_path / "nope.jsonl")]) == 1
+    capsys.readouterr()
+
+
+def test_cli_usage_exit_2(capsys):
+    assert tcli.main([]) == 2
+    capsys.readouterr()
+
+
+def test_cli_skips_malformed_lines(tmp_path, capsys):
+    log = tmp_path / "run.jsonl"
+    _write_demo_log(log)
+    with open(log, "a") as f:
+        f.write("not json at all\n{\"kind\": \"mystery\"}\n")
+    rc = tcli.main(["summarize", str(log), "--json"])
+    agg = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert agg["skipped"] >= 1
+
+
+# ---------------------------------------------------------------------
+# runtime retrace counter (the LAMB class of regression, caught live)
+# ---------------------------------------------------------------------
+
+def test_runtime_retrace_counter_lamb_style():
+    """PR 1 found the LAMB recompile statically (``t`` baked into the
+    eager-jit key).  This proves the RUNTIME side: (a) the fixed LAMB
+    op does not retrace as ``t`` varies, and (b) an op whose static
+    param varies per call -- the same regression class -- fires the
+    retrace event with the changed param named in the payload."""
+    telemetry.enable()
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,)) * 0.1
+    m = mx.nd.zeros((4,))
+    v = mx.nd.zeros((4,))
+    # warm the cache entry for this signature
+    mx.nd.lamb_update_phase1(w, g, m, v, t=1)
+    retraces_before = telemetry.counter("compile.retraces").value
+    for t in range(2, 6):
+        mx.nd.lamb_update_phase1(w, g, m, v, t=t)
+    assert telemetry.counter("compile.retraces").value == retraces_before, \
+        "varying t recompiled LAMB -- the PR 1 regression is back"
+
+    # LAMB-style regression reproduced: a float param that is NOT in
+    # _DYNAMIC_PARAMS enters the cache key, so varying it per step
+    # compiles per step -- the runtime counter must catch it
+    x = mx.nd.ones((2, 3))
+    ev = telemetry.event("compile")
+    before = telemetry.counter("compile.retraces").value
+    for i in range(3):
+        mx.nd.clip(x, a_min=0.001 * i + 0.5101, a_max=9.3303)
+    after = telemetry.counter("compile.retraces").value
+    assert after >= before + 2, "per-step static-param recompile not flagged"
+    last = [e for e in ev.recent
+            if e.get("site") == "eager_jit" and e.get("retrace")][-1]
+    assert last["op"] == "clip"
+    assert "a_min" in last["changed"]
+
+
+def test_hybrid_retrace_event_payload_names_cache_key_diff():
+    telemetry.enable()
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.ones((1, 3)))
+    ev = telemetry.event("compile")
+    n_before = ev.count
+    net(mx.nd.ones((5, 3)))          # bucketing: new leading dim
+    hybrid = [e for e in ev.recent if e.get("site") == "hybrid_cache"]
+    assert ev.count > n_before
+    assert hybrid[-1]["retrace"] is True
+    assert hybrid[-1]["changed"] == ["arg0.shape"]
+    assert hybrid[-1]["block"] == "Dense"
+    assert telemetry.timer("compile.build_time").count >= 1
+
+
+def test_trainer_step_and_kvstore_metrics():
+    telemetry.enable()
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01})
+    for _ in range(2):
+        with autograd.record():
+            loss = net(mx.nd.ones((8, 4))).sum()
+        loss.backward()
+        trainer.step(8)
+    assert telemetry.counter("trainer.steps").value == 2
+    assert telemetry.counter("trainer.samples").value == 16
+    assert telemetry.timer("trainer.step_time").count == 2
+    assert telemetry.gauge("trainer.samples_per_sec").value > 0
+    # Dense(2, in 4): weight 4*2*4B + bias 2*4B = 40B per step
+    assert telemetry.counter("kvstore.bytes").value == 80
+    assert telemetry.counter("kvstore.pushpull").value == 4
+    assert telemetry.timer("kvstore.time").count == 4
+
+
+def test_dataloader_wait_time_metrics():
+    telemetry.enable()
+    ds = gluon.data.ArrayDataset(
+        mx.nd.array(np.arange(24, dtype=np.float32).reshape(12, 2)),
+        mx.nd.array(np.arange(12, dtype=np.float32)))
+    for _x, _y in gluon.data.DataLoader(ds, batch_size=4, num_workers=2):
+        pass
+    assert telemetry.counter("data.batches").value == 3
+    t = telemetry.timer("data.wait_time").snapshot()
+    assert t["count"] == 3 and t["sum"] > 0
+
+
+def test_speedometer_feeds_throughput_gauge():
+    from collections import namedtuple
+    telemetry.enable()
+    BatchEndParam = namedtuple("BatchEndParam",
+                               ["epoch", "nbatch", "eval_metric", "locals"])
+    speedo = mx.callback.Speedometer(batch_size=32, frequent=2,
+                                     auto_reset=False)
+    for nbatch in range(1, 5):
+        speedo(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    gauge = telemetry.gauge("trainer.samples_per_sec")
+    assert gauge.value is not None and gauge.value > 0
+    assert gauge.value == speedo.last_speed
+
+
+def test_amp_overflow_and_rescale_events():
+    from mxnet_tpu.amp.loss_scaler import LossScaler
+    telemetry.enable()
+    sc = LossScaler(init_scale=2.0 ** 10, scale_window=2)
+    sc.update_scale(overflow=True)
+    assert telemetry.counter("amp.overflows").value == 1
+    ov = telemetry.event("amp.overflow").recent[-1]
+    assert ov["scale_before"] == 2.0 ** 10
+    assert ov["scale_after"] == 2.0 ** 9
+    sc.update_scale(overflow=False)
+    sc.update_scale(overflow=False)   # window met -> rescale event
+    rs = telemetry.event("amp.rescale").recent[-1]
+    assert rs["scale_after"] == 2.0 ** 10
+    assert telemetry.gauge("amp.loss_scale").value == 2.0 ** 10
+
+
+def test_preemption_checkpoint_events(tmp_path):
+    telemetry.enable()
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    net(mx.nd.ones((1, 3)))
+    prefix = str(tmp_path / "job")
+    handler = mx.preemption.install(prefix, net)
+    try:
+        handler.save_now(step=7)
+    finally:
+        handler.uninstall()
+    saves = telemetry.event("checkpoint").recent
+    assert saves[-1]["action"] == "save" and saves[-1]["step"] == 7
+    meta = mx.preemption.resume(prefix, net)
+    assert meta["step"] == 7
+    assert telemetry.event("checkpoint").recent[-1]["action"] == "restore"
+    assert telemetry.counter("checkpoint.saves").value == 1
+    assert telemetry.counter("checkpoint.restores").value == 1
+
+
+def test_executor_compile_event():
+    telemetry.enable()
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    ev = telemetry.event("compile")
+    n0 = len([e for e in ev.recent if str(e.get("site", ""))
+              .startswith("executor.")])
+    ex.forward(is_train=False)
+    ex.forward(is_train=False)   # second call: cache hit, no new event
+    exec_events = [e for e in ev.recent
+                   if str(e.get("site", "")).startswith("executor.")]
+    assert len(exec_events) == n0 + 1
+    assert exec_events[-1]["seconds"] > 0
+
+
+def test_env_vars_registered():
+    desc = mx.env.describe()
+    assert "MXNET_TPU_TELEMETRY" in desc
+    assert "MXNET_TPU_TELEMETRY_JSONL" in desc
+    assert mx.env.get("MXNET_TPU_TELEMETRY") in (False, True)
