@@ -6,6 +6,10 @@
 #   examples -> the runnable examples smoke-tested via their test file
 #   telemetry -> 3-step smoke train with the JSONL sink on, then the
 #                summarize CLI must report non-empty step/compile data
+#   checkpoint -> save-every-step smoke train, simulated preemption
+#                 (kill-mid-write corruption of the newest step),
+#                 resume must fall back to the previous good step and
+#                 the telemetry JSONL must record the restore event
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -14,7 +18,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -83,6 +87,99 @@ print("telemetry gate ok: %d steps, %d compiles, %d kv bytes"
          agg["kvstore"]["bytes"]))
 EOF
     rm -f "$tjsonl" "$tjsonl.agg"
+}
+
+run_checkpoint() {
+    log "checkpoint: train+save every step -> preempt -> verified resume"
+    ckdir=$(mktemp -d /tmp/mxtpu_ckpt_ci.XXXXXX)
+    # phase 1: 3 steps, a managed save per step, then a simulated
+    # preemption: the newest step's params are truncated (the on-disk
+    # state a SIGKILL mid-write leaves) and the process dies abruptly
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 \
+        MXNET_TPU_TELEMETRY_JSONL="$ckdir/run.jsonl" \
+        python - "$ckdir" <<'EOF'
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+ckdir = sys.argv[1]
+mgr = mx.checkpoint.CheckpointManager(os.path.join(ckdir, "ckpts"))
+net = gluon.nn.Dense(4)
+net.initialize(); net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+loss_fn = gluon.loss.L2Loss()
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(4, 8).astype(np.float32))
+y = mx.nd.array(rng.rand(4, 4).astype(np.float32))
+for step in range(1, 4):                  # 3 steps, save EVERY step
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(4)
+    mgr.save_training(step, net, tr, metadata={"step": step})
+assert mgr.latest_step() == 3
+# simulated preemption: SIGKILL lands mid-write of a 4th checkpoint --
+# fake the torn on-disk state by truncating the newest step's params
+with open(os.path.join(mgr.step_dir(3), "params.params"), "r+b") as f:
+    f.truncate(8)
+print("phase-1 trained 3 steps, tore step 3", flush=True)
+os._exit(0)                               # abrupt exit: no atexit, no flush
+EOF
+    # phase 2: fresh process resumes; must fall back to step 2
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 \
+        MXNET_TPU_TELEMETRY_JSONL="$ckdir/run.jsonl" \
+        python - "$ckdir" <<'EOF'
+import os, sys, warnings
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, telemetry
+
+ckdir = sys.argv[1]
+mgr = mx.checkpoint.CheckpointManager(os.path.join(ckdir, "ckpts"))
+net = gluon.nn.Dense(4)
+net.initialize(); net.hybridize()
+x = mx.nd.array(np.zeros((4, 8), np.float32))
+net(x)
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore", RuntimeWarning)   # the torn step 3
+    ckpt = mgr.restore_training(net, tr)
+assert ckpt is not None, "resume found no checkpoint"
+assert ckpt.step == 2, "expected fallback to step 2, got %r" % ckpt.step
+assert ckpt.metadata["step"] == 2
+# step continuity: training resumes at the step after the checkpoint
+y = mx.nd.array(np.zeros((4, 4), np.float32))
+loss_fn = gluon.loss.L2Loss()
+for step in range(ckpt.step + 1, ckpt.step + 3):
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    tr.step(4)
+    mgr.save_training(step, net, tr, metadata={"step": step})
+assert mgr.latest_step() == 4
+telemetry.flush()
+print("phase-2 resumed at step %d, continued to %d"
+      % (ckpt.step, mgr.latest_step()), flush=True)
+EOF
+    # gate: the shared JSONL must record the restore event
+    python - "$ckdir/run.jsonl" <<'EOF'
+import json, sys
+actions = []
+for line in open(sys.argv[1]):
+    rec = json.loads(line)
+    if rec.get("kind") == "event" and rec.get("name") == "checkpoint":
+        actions.append((rec.get("payload") or {}).get("action"))
+assert "restore" in actions, "no restore event in telemetry: %s" % actions
+# phase 1's buffered lines died with os._exit (as they would under a
+# real SIGKILL); phase 2's post-resume saves must be here
+assert actions.count("save") >= 2, actions
+print("checkpoint gate ok: %d saves, %d restores recorded"
+      % (actions.count("save"), actions.count("restore")))
+EOF
+    rm -rf "$ckdir"
 }
 
 run_bench() {

@@ -132,6 +132,7 @@ visualization = viz
 from . import onnx
 from . import contrib
 from . import env
+from . import checkpoint
 from . import preemption
 from . import horovod
 from . import analysis

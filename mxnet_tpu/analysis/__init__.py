@@ -11,7 +11,9 @@ device time is spent (docs/analysis.md):
   or ``MXNET_TPU_GRAPH_CHECK=1``.
 - :func:`lint_paths` -- AST-lint source trees for trace-unsafe Python
   (host syncs and value branches in compiled scopes, mutable defaults,
-  bare ``except:``).
+  bare ``except:``) and for non-atomic state writes (bare
+  ``open(..., "wb")`` in save paths outside ``checkpoint/core.py`` --
+  the ISSUE 3 torn-write guard).
 - :func:`audit_retrace` -- cross-reference op param specs with the
   compile-cache keys to flag unbounded-recompilation hazards.
 
@@ -23,6 +25,7 @@ from .core import (Diagnostic, Rule, RULES, rule, get_rule, list_rules,
                    render_human, render_json, ERROR, WARNING)
 from .graph_check import GraphCheckError, assert_graph_ok, check_symbol
 from .trace_lint import lint_file, lint_paths, lint_source
+from . import state_write  # noqa: F401  (registers bare-state-write)
 from .retrace import audit_retrace
 from .cli import main
 

@@ -26,12 +26,38 @@ def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
 
 def do_checkpoint(prefix, period=1):
     """Epoch-end callback: ``save_checkpoint(prefix, epoch+1, ...)``
-    (reference: ``callback.py :: do_checkpoint``)."""
+    (reference: ``callback.py :: do_checkpoint``).  Writes are atomic
+    (mx.checkpoint commit) since the ISSUE 3 rebase."""
     period = int(max(1, period))
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
             save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    return _callback
+
+
+def managed_checkpoint(manager, period=1, metadata_fn=None):
+    """Epoch-end callback saving through a
+    :class:`mx.checkpoint.CheckpointManager` -- manifest-verified,
+    retention-pruned, optionally async -- instead of bare prefix files.
+
+    ``manager`` owns layout and retention; ``metadata_fn(iter_no)``
+    (optional) supplies the manifest's user metadata.
+    """
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period != 0:
+            return
+        items = {}
+        if arg:
+            items["params"] = {"arg:%s" % k: v for k, v in arg.items()}
+            items["params"].update(
+                {"aux:%s" % k: v for k, v in (aux or {}).items()})
+        if not items:
+            return
+        meta = metadata_fn(iter_no) if metadata_fn is not None else None
+        manager.save(iter_no + 1, items, metadata=meta)
     return _callback
 
 

@@ -1,0 +1,43 @@
+"""``mxnet_tpu.checkpoint``: async, sharded, managed checkpoints with
+atomic commit, integrity verification, and retention (ISSUE 3).
+
+The one subsystem every save/restore path goes through -- the way
+``mx.analysis`` unified static checks and ``mx.telemetry`` unified
+metrics.  Three layers (docs/checkpointing.md):
+
+- :mod:`~mxnet_tpu.checkpoint.core` -- tmp+fsync+rename atomic file
+  commits, step-numbered checkpoint directories with a
+  checksum-carrying manifest committed LAST, and a
+  :class:`CheckpointManager` with corruption-tolerant discovery and
+  retention;
+- :mod:`~mxnet_tpu.checkpoint.async_writer` -- host snapshot at the
+  loop boundary, serialize/commit on a background thread,
+  at-most-one-in-flight, errors re-raised at the next save/wait;
+- :mod:`~mxnet_tpu.checkpoint.sharded` -- multi-process runs write
+  per-process shard files, barrier, process 0 commits the merged
+  manifest; restore reassembles and reshards to the *current* mesh.
+
+Rebased onto this subsystem: ``mx.preemption`` (SIGTERM checkpoints,
+now checksum-verified on resume), ``gluon.Trainer.save_states``,
+``KVStore.save_optimizer_states``, ``mx.model.save_checkpoint`` /
+``Module.save_checkpoint``, and ``mx.callback`` checkpoints.
+
+Env knobs: ``MXNET_TPU_CKPT_ASYNC`` (background writes),
+``MXNET_TPU_CKPT_MAX_TO_KEEP`` (retention).
+"""
+from .core import (Checkpoint, CheckpointError, CheckpointManager,
+                   atomic_write_bytes, commit, file_digest,
+                   load_manifest, sweep_stale_tmps, verify_files,
+                   FORMAT_VERSION, MANIFEST_NAME)
+from .async_writer import AsyncWriter, snapshot_items
+from . import core
+from . import async_writer
+from . import sharded
+
+__all__ = [
+    "Checkpoint", "CheckpointError", "CheckpointManager", "AsyncWriter",
+    "atomic_write_bytes", "commit", "file_digest", "load_manifest",
+    "snapshot_items", "sweep_stale_tmps", "verify_files",
+    "FORMAT_VERSION", "MANIFEST_NAME",
+    "core", "async_writer", "sharded",
+]

@@ -1,0 +1,613 @@
+"""Atomic, manifest-verified, step-numbered checkpoints (ISSUE 3).
+
+The paper's failure story is checkpoint/restart (SURVEY §5); on a TPU
+fleet the failure is *preemption*, and a production run needs exactly
+one answer to "where is the newest checkpoint that actually loads".
+Before this subsystem the package had five ad-hoc save paths with five
+different torn-write behaviors; they all now route through here.
+
+Two layers in this module:
+
+**File commits** -- :func:`commit` writes through a ``<path>.<pid>.tmp``
+staging file, fsyncs, then renames (``os.replace``) into place, so a
+SIGKILL at any instant leaves either the old file or the new file,
+never a truncated hybrid.  Every commit also sweeps stale temps left by
+previously killed writers (:func:`sweep_stale_tmps`).
+
+**Managed step directories** -- :class:`CheckpointManager` owns a root
+directory of ``step_<N>/`` checkpoints.  A save stages every file in
+``step_<N>.<pid>.tmp/``, fsyncs, writes ``manifest.json`` (per-file
+byte sizes + CRC32 checksums, process topology, step, user metadata)
+LAST, then renames the whole directory into place.  Discovery is
+corruption-tolerant: a step whose manifest is missing/invalid or whose
+checksums mismatch is skipped with a warning and the previous good step
+wins -- a half-written checkpoint can cost one step of progress, never
+the job.  Retention (``max_to_keep`` / ``keep_every_n_steps``) and
+async writing (``checkpoint/async_writer.py``) hang off the manager;
+multi-process sharded layouts live in ``checkpoint/sharded.py``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+import warnings
+import zlib
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+
+__all__ = [
+    "CheckpointError", "CheckpointManager", "Checkpoint",
+    "commit", "atomic_write_bytes", "sweep_stale_tmps",
+    "file_digest", "load_manifest", "verify_files",
+    "MANIFEST_NAME", "FORMAT_VERSION",
+]
+
+MANIFEST_NAME = "manifest.json"
+FORMAT_VERSION = 1
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+_TMP_RE = re.compile(r"\.(\d+)\.tmp$")
+_DIGEST_CHUNK = 1 << 20
+
+
+class CheckpointError(MXNetError):
+    """A checkpoint failed to commit or verify."""
+
+
+# ----------------------------------------------------------------------
+# file commits
+# ----------------------------------------------------------------------
+
+def _fsync_dir(path):
+    """Durably record a rename/create in its directory (best-effort:
+    some filesystems refuse O_RDONLY dir fsync)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_and_digest(path):
+    """fsync ``path`` and return ``(nbytes, crc32)`` in one pass."""
+    crc = 0
+    nbytes = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_DIGEST_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            nbytes += len(chunk)
+        os.fsync(f.fileno())
+    return nbytes, crc & 0xFFFFFFFF
+
+
+def file_digest(path):
+    """``(nbytes, crc32)`` of a file (no fsync; verification reads)."""
+    crc = 0
+    nbytes = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_DIGEST_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            nbytes += len(chunk)
+    return nbytes, crc & 0xFFFFFFFF
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    return True
+
+
+def sweep_stale_tmps(dirpath, prefix=None):
+    """Remove ``*.<pid>.tmp`` files/dirs whose writer process is dead.
+
+    A save killed between ``write_fn(tmp)`` and ``os.replace`` strands
+    its temp forever (satellite: the pre-subsystem paths leaked these).
+    Called at manager init and by every :func:`commit`.  Temps of LIVE
+    pids (including our own in-flight async writer) are left alone.
+    Returns the paths removed.
+    """
+    removed = []
+    try:
+        entries = os.listdir(dirpath)
+    except OSError:
+        return removed
+    for name in entries:
+        m = _TMP_RE.search(name)
+        if m is None:
+            continue
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        path = os.path.join(dirpath, name)
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+            else:
+                os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    return removed
+
+
+def commit(path, write_fn):
+    """Atomically publish one file: ``write_fn(tmp)`` -> fsync ->
+    ``os.replace(tmp, path)``.  Returns ``(nbytes, crc32)`` of the
+    committed bytes, so callers can manifest what they wrote.
+
+    On any failure the temp is removed and the previous ``path`` (if
+    any) is untouched -- a crashed or raising writer can never leave a
+    truncated file where a loadable one used to be.
+    """
+    path = os.fspath(path)
+    tmp = "%s.%d.tmp" % (path, os.getpid())
+    try:
+        write_fn(tmp)
+        nbytes, crc = _fsync_and_digest(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    parent = os.path.dirname(path) or "."
+    _fsync_dir(parent)
+    sweep_stale_tmps(parent, prefix=os.path.basename(path))
+    return nbytes, crc
+
+
+def atomic_write_bytes(path, data):
+    """Atomically replace ``path`` with ``data`` (bytes).  The shared
+    helper behind every "write one state blob" site (Trainer.save_states,
+    KVStore.save_optimizer_states, Module's ``.states`` files)."""
+    def _write(tmp):
+        with open(tmp, "wb") as f:
+            f.write(data)
+    return commit(path, _write)
+
+
+# ----------------------------------------------------------------------
+# manifests
+# ----------------------------------------------------------------------
+
+def load_manifest(dirpath):
+    """Parse ``manifest.json`` of a step dir; raises CheckpointError if
+    missing or invalid."""
+    mpath = os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise CheckpointError("no manifest in %s: %s" % (dirpath, e)) from e
+    except ValueError as e:
+        raise CheckpointError("invalid manifest in %s: %s"
+                              % (dirpath, e)) from e
+    if not isinstance(manifest, dict) or "files" not in manifest:
+        raise CheckpointError("malformed manifest in %s" % dirpath)
+    return manifest
+
+
+def verify_files(dirpath, files):
+    """Check every manifest entry against the bytes on disk.  Returns a
+    list of problem strings (empty = intact).  ``files`` is the
+    manifest's ``{fname: {"bytes": n, "crc32": c, ...}}`` mapping."""
+    problems = []
+    for fname, entry in files.items():
+        fpath = os.path.join(dirpath, fname)
+        if not os.path.exists(fpath):
+            problems.append("missing file %r" % fname)
+            continue
+        nbytes, crc = file_digest(fpath)
+        if nbytes != entry.get("bytes"):
+            problems.append("size mismatch on %r: %d != %d"
+                            % (fname, nbytes, entry.get("bytes")))
+        elif crc != entry.get("crc32"):
+            problems.append("crc32 mismatch on %r" % fname)
+    return problems
+
+
+def _topology():
+    from ..distributed import world
+    try:
+        nprocs, rank = world()
+    except Exception:
+        nprocs, rank = 1, 0
+    return {"num_processes": int(nprocs), "process_id": int(rank)}
+
+
+# ----------------------------------------------------------------------
+# item (de)serialization -- shared with sharded.py
+# ----------------------------------------------------------------------
+# A checkpoint's payload is a dict of named *items*; each item is either
+# a dict of arrays (saved in the .params container format) or raw bytes
+# (an opaque state blob, e.g. Trainer.save_states output).
+
+def write_item(dirpath, name, kind, payload):
+    """Write one staged item file; returns its manifest entry.  Inside
+    staging there is no concurrent reader, so the write is plain -- the
+    atomicity boundary is the directory rename."""
+    if kind == "params":
+        from .. import ndarray as nd
+        fname = name + ".params"
+        nd.save(os.path.join(dirpath, fname), payload)
+    elif kind == "bin":
+        fname = name + ".bin"
+        with open(os.path.join(dirpath, fname), "wb") as f:
+            f.write(payload)
+    else:
+        raise CheckpointError("unknown item kind %r" % kind)
+    nbytes, crc = _fsync_and_digest(os.path.join(dirpath, fname))
+    return fname, {"bytes": nbytes, "crc32": crc, "kind": kind,
+                   "item": name}
+
+
+def read_item(dirpath, fname, entry):
+    """Load one manifest entry back into its Python value."""
+    kind = entry.get("kind", "bin")
+    fpath = os.path.join(dirpath, fname)
+    if kind == "params":
+        from .. import ndarray as nd
+        return nd.load(fpath)
+    if kind == "bin":
+        with open(fpath, "rb") as f:
+            return f.read()
+    raise CheckpointError("unknown item kind %r in manifest" % kind)
+
+
+class Checkpoint:
+    """What :meth:`CheckpointManager.restore` returns: ``step``, the
+    ``items`` dict (name -> dict-of-NDArray or bytes), and the user
+    ``metadata`` saved alongside."""
+
+    __slots__ = ("step", "items", "metadata")
+
+    def __init__(self, step, items, metadata):
+        self.step = step
+        self.items = items
+        self.metadata = metadata
+
+    def __repr__(self):
+        return "Checkpoint(step=%d, items=%s)" % (self.step,
+                                                  sorted(self.items))
+
+
+# ----------------------------------------------------------------------
+# manager
+# ----------------------------------------------------------------------
+
+class CheckpointManager:
+    """Managed step-numbered checkpoints under one root directory.
+
+    ::
+
+        mgr = mx.checkpoint.CheckpointManager(root, max_to_keep=3)
+        mgr.save(step, {"params": net._collect_arrays(),
+                        "trainer": trainer.get_states()})
+        ...
+        ckpt = mgr.restore()          # newest intact step (or None)
+
+    ``items`` values are dicts of arrays (saved as ``.params``) or raw
+    ``bytes`` blobs.  Convenience wrappers :meth:`save_training` /
+    :meth:`restore_training` handle the (block, trainer) pair directly.
+
+    Options (``None`` defers to the env registry):
+
+    - ``max_to_keep`` (``MXNET_TPU_CKPT_MAX_TO_KEEP``; 0 = unlimited):
+      oldest steps beyond this many are deleted after each save.
+    - ``keep_every_n_steps``: steps divisible by this are exempt from
+      ``max_to_keep`` deletion (sparse long-horizon history).
+    - ``async_save`` (``MXNET_TPU_CKPT_ASYNC``): snapshot to host at
+      ``save()`` (after a ``waitall`` drain), then serialize/commit on
+      a background thread so training overlaps the I/O.  At most one
+      save is in flight; a new save drains the previous one first, and
+      a writer error re-raises at the next ``save``/``wait``.
+    - ``sharded`` (default: auto = multi-process runs): each process
+      writes only its addressable shards; see ``checkpoint/sharded.py``.
+    """
+
+    def __init__(self, root, max_to_keep=None, keep_every_n_steps=None,
+                 async_save=None, sharded=None):
+        from .. import env as _env
+        self.root = os.fspath(root)
+        if max_to_keep is None:
+            max_to_keep = _env.get("MXNET_TPU_CKPT_MAX_TO_KEEP") or None
+        if max_to_keep is not None and max_to_keep < 1:
+            max_to_keep = None
+        self.max_to_keep = max_to_keep
+        self.keep_every_n_steps = keep_every_n_steps or None
+        if async_save is None:
+            async_save = _env.get("MXNET_TPU_CKPT_ASYNC")
+        self._sharded = sharded
+        self._writer = None
+        if async_save:
+            from .async_writer import AsyncWriter
+            self._writer = AsyncWriter()
+        os.makedirs(self.root, exist_ok=True)
+        sweep_stale_tmps(self.root)
+
+    # -- layout --------------------------------------------------------
+    def step_dir(self, step):
+        return os.path.join(self.root, "step_%08d" % int(step))
+
+    def all_steps(self):
+        """Every committed step number, ascending (no intactness check:
+        use :meth:`latest_step` for 'newest that actually loads')."""
+        steps = []
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return steps
+        for name in entries:
+            m = _STEP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.root, name)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _verify_step(self, step):
+        """Manifest of an intact step, or None (with a warning)."""
+        dirpath = self.step_dir(step)
+        try:
+            manifest = load_manifest(dirpath)
+            problems = verify_files(dirpath, manifest["files"])
+        except CheckpointError as e:
+            problems = [str(e)]
+            manifest = None
+        if problems:
+            warnings.warn(
+                "checkpoint step %d at %s failed verification (%s); "
+                "skipping it" % (step, dirpath, "; ".join(problems)),
+                RuntimeWarning, stacklevel=3)
+            return None
+        return manifest
+
+    def latest_step(self):
+        """Newest step that passes manifest + checksum verification, or
+        None.  A torn/corrupted newest step falls back to the previous
+        good one -- the property the atomic commit protocol exists
+        for."""
+        for step in reversed(self.all_steps()):
+            if self._verify_step(step) is not None:
+                return step
+        return None
+
+    # -- save ----------------------------------------------------------
+    def save(self, step, items, metadata=None):
+        """Checkpoint ``items`` as ``step``.  Synchronous unless the
+        manager was built with ``async_save``; either way the device
+        queue is drained and the state snapshotted to host *before*
+        this returns, so the training loop may mutate params
+        immediately."""
+        step = int(step)
+        if not isinstance(items, dict) or not items:
+            raise CheckpointError("save() needs a non-empty items dict")
+        if self._writer is not None:
+            self._writer.check()        # re-raise a prior writer error
+        from .async_writer import snapshot_items
+        t0 = time.perf_counter()
+        if self._use_sharded():
+            from . import sharded
+            nbytes = sharded.save_sharded(self, step, items, metadata)
+            self._record_save(step, nbytes, time.perf_counter() - t0,
+                              async_save=False)
+            return
+        snapshot = snapshot_items(items)
+
+        def _write():
+            nbytes = self._write_step(step, snapshot, metadata)
+            self._apply_retention()
+            return nbytes
+
+        if self._writer is not None:
+            self._writer.submit(_write, step=step)
+            self._record_save(step, None, time.perf_counter() - t0,
+                              async_save=True)
+        else:
+            nbytes = _write()
+            self._record_save(step, nbytes, time.perf_counter() - t0,
+                              async_save=False)
+
+    def _use_sharded(self):
+        if self._sharded is not None:
+            return self._sharded
+        return _topology()["num_processes"] > 1
+
+    def _record_save(self, step, nbytes, seconds, async_save):
+        if _telemetry._ENABLED:
+            _telemetry.hooks.checkpoint("save", nbytes=nbytes,
+                                        seconds=seconds, step=step,
+                                        root=self.root,
+                                        async_save=async_save)
+
+    def _write_step(self, step, snapshot, metadata):
+        """Serialize a host snapshot into a staged dir and commit it.
+        Runs on the writer thread under async saves."""
+        final = self.step_dir(step)
+        staging = "%s.%d.tmp" % (final, os.getpid())
+        if os.path.isdir(staging):
+            shutil.rmtree(staging)
+        os.makedirs(staging)
+        files = {}
+        total = 0
+        for name, (kind, payload) in sorted(snapshot.items()):
+            fname, entry = write_item(staging, name, kind, payload)
+            files[fname] = entry
+            total += entry["bytes"]
+        manifest = {
+            "format_version": FORMAT_VERSION,
+            "step": step,
+            "files": files,
+            "topology": _topology(),
+            "metadata": metadata or {},
+        }
+
+        def _write_manifest(tmp):
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+        # manifest LAST: its presence asserts every data file above it
+        # is complete, so the rename below publishes all-or-nothing
+        commit(os.path.join(staging, MANIFEST_NAME), _write_manifest)
+        _fsync_dir(staging)
+        if os.path.isdir(final):        # re-saving an existing step
+            shutil.rmtree(final)
+        os.replace(staging, final)
+        _fsync_dir(self.root)
+        sweep_stale_tmps(self.root)
+        return total
+
+    def _apply_retention(self):
+        if self.max_to_keep is None:
+            return
+        steps = self.all_steps()
+        keep_n = self.keep_every_n_steps
+        candidates = [s for s in steps
+                      if not (keep_n and s % keep_n == 0)]
+        excess = len(candidates) - self.max_to_keep
+        for step in candidates[:max(0, excess)]:
+            shutil.rmtree(self.step_dir(step), ignore_errors=True)
+
+    # -- restore -------------------------------------------------------
+    def restore(self, step=None, sharding=None):
+        """Load a checkpoint: the newest intact step when ``step`` is
+        None (returning None if there is none at all), or exactly
+        ``step`` (raising CheckpointError if it fails verification).
+
+        ``sharding`` optionally maps restored arrays onto the *current*
+        mesh: a callable ``(item, key, shape) -> jax.sharding.Sharding``
+        (or None for host placement) applied to every array -- this is
+        how a job resumes on a different topology than it saved from.
+        """
+        self.wait_until_finished()
+        t0 = time.perf_counter()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+            manifest = self._verify_step(step)
+            if manifest is None:        # raced a concurrent delete
+                return None
+        else:
+            step = int(step)
+            manifest = self._verify_step(step)
+            if manifest is None:
+                raise CheckpointError(
+                    "checkpoint step %d failed verification" % step)
+        dirpath = self.step_dir(step)
+        if any(e.get("kind") == "shard"
+               for e in manifest["files"].values()):
+            from . import sharded
+            items, nbytes = sharded.restore_sharded(
+                dirpath, manifest, sharding=sharding)
+        else:
+            items = {}
+            nbytes = 0
+            for fname, entry in sorted(manifest["files"].items()):
+                items[entry.get("item", fname)] = \
+                    read_item(dirpath, fname, entry)
+                nbytes += entry.get("bytes", 0)
+            if sharding is not None:
+                items = _apply_sharding(items, sharding)
+        if _telemetry._ENABLED:
+            _telemetry.hooks.checkpoint(
+                "restore", nbytes=nbytes,
+                seconds=time.perf_counter() - t0, step=step,
+                root=self.root)
+        return Checkpoint(step, items, manifest.get("metadata", {}))
+
+    # -- training-loop conveniences ------------------------------------
+    def save_training(self, step, block, trainer=None, metadata=None):
+        """Checkpoint a Gluon block (+ optional Trainer state)."""
+        items = {"params": {k: p._reduce() for k, p in
+                            block._collect_params_with_prefix().items()
+                            if p._data is not None}}
+        if trainer is not None:
+            items["trainer"] = trainer.get_states()
+        self.save(step, items, metadata=metadata)
+
+    def restore_training(self, block, trainer=None, step=None, ctx=None):
+        """Restore :meth:`save_training` state in place.  Returns the
+        Checkpoint (or None on a fresh start)."""
+        ckpt = self.restore(step=step)
+        if ckpt is None:
+            return None
+        params = ckpt.items.get("params")
+        if params is not None:
+            _load_block_params(block, params, ctx=ctx)
+        if trainer is not None and "trainer" in ckpt.items:
+            trainer.set_states(ckpt.items["trainer"])
+        return ckpt
+
+    # -- async plumbing ------------------------------------------------
+    def wait_until_finished(self):
+        """Block until any in-flight async save has committed; re-raises
+        the writer's error if it failed."""
+        if self._writer is not None:
+            self._writer.wait_until_finished()
+
+    def close(self):
+        self.wait_until_finished()
+
+
+def _apply_sharding(items, sharding):
+    import jax
+    from .. import ndarray as nd
+    out = {}
+    for name, value in items.items():
+        if not isinstance(value, dict):
+            out[name] = value
+            continue
+        placed = {}
+        for k, v in value.items():
+            arr = v.asnumpy() if isinstance(v, nd.NDArray) else v
+            s = sharding(name, k, arr.shape) if callable(sharding) \
+                else sharding.get((name, k)) if isinstance(sharding, dict) \
+                else sharding
+            placed[k] = nd.NDArray(jax.device_put(arr, s)) \
+                if s is not None else nd.NDArray(arr)
+        out[name] = placed
+    return out
+
+
+def _load_block_params(block, params, ctx=None):
+    """Assign a restored params dict onto a block by structural name
+    (same contract as Block.load_parameters, but from in-memory
+    arrays)."""
+    from .. import ndarray as nd
+    targets = block._collect_params_with_prefix()
+    for name, data in params.items():
+        if name not in targets:
+            raise CheckpointError(
+                "restored parameter %r not found in block" % name)
+        p = targets[name]
+        if not isinstance(data, nd.NDArray):
+            data = nd.NDArray(data)
+        if p._data is None:
+            p._shape = data.shape
+            p._deferred_init = None
+            p._data = data
+            if p._grad_req != "null":
+                p._init_grad()
+        else:
+            p._data._data = data.as_in_context(p._data.context)._data

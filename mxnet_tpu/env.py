@@ -120,6 +120,19 @@ _VARS = [
            "mx.telemetry.flush()) -- analyze offline with 'python -m "
            "mxnet_tpu.telemetry summarize <path>'.  Implies nothing "
            "about MXNET_TPU_TELEMETRY: set both to record."),
+    EnvVar("MXNET_TPU_CKPT_ASYNC", bool, False,
+           "'1' makes CheckpointManager saves asynchronous by default: "
+           "params/optimizer state snapshot to host at save() (after a "
+           "waitall drain), then serialize/fsync/commit on a background "
+           "thread so training overlaps the I/O.  At most one save is "
+           "in flight; writer errors re-raise at the next save/wait.  "
+           "Per-manager override: CheckpointManager(async_save=...)."),
+    EnvVar("MXNET_TPU_CKPT_MAX_TO_KEEP", int, 0,
+           "Default retention for CheckpointManager: keep at most this "
+           "many step checkpoints, deleting the oldest after each save "
+           "(steps matching keep_every_n_steps are exempt).  0 keeps "
+           "everything.  Per-manager override: "
+           "CheckpointManager(max_to_keep=...)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -223,11 +223,22 @@ class Trainer:
                     p._data._data = outs[k]._data
         return True
 
+    def get_states(self):
+        """Optimizer state as an opaque bytes blob (what
+        ``CheckpointManager`` stores for the ``trainer`` item)."""
+        return self._updater.get_states(dump_optimizer=False)
+
+    def set_states(self, states):
+        self._updater.set_states(states)
+
     def save_states(self, fname):
-        """Reference: ``Trainer.save_states`` -- optimizer state blob."""
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer=False))
+        """Reference: ``Trainer.save_states`` -- optimizer state blob.
+        Committed atomically (tmp+fsync+rename via mx.checkpoint): a
+        SIGKILL mid-write can no longer leave a truncated .states file
+        that loads garbage."""
+        from ..checkpoint.core import atomic_write_bytes
+        atomic_write_bytes(fname, self.get_states())
 
     def load_states(self, fname):
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            self.set_states(f.read())

@@ -305,8 +305,9 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        # atomic tmp+fsync+rename (mx.checkpoint): never a torn .states
+        from .checkpoint.core import atomic_write_bytes
+        atomic_write_bytes(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

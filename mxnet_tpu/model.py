@@ -20,14 +20,18 @@ BatchEndParam = namedtuple("BatchEndParam",
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
     """Save graph + parameters for ``epoch`` (reference:
-    ``model.py :: save_checkpoint``)."""
+    ``model.py :: save_checkpoint``).  Both files commit atomically
+    through mx.checkpoint (tmp+fsync+rename), so a kill mid-save leaves
+    the previous epoch's files intact instead of a truncated graph or
+    params container."""
+    from .checkpoint.core import commit
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        commit("%s-symbol.json" % prefix, symbol.save)
     save_dict = {("arg:%s" % k): v for k, v in (arg_params or {}).items()}
     save_dict.update({("aux:%s" % k): v
                       for k, v in (aux_params or {}).items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    commit(param_name, lambda tmp: nd.save(tmp, save_dict))
     return param_name
 
 

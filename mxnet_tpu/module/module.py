@@ -292,8 +292,9 @@ class Module(BaseModule):
         save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
         if save_optimizer_states:
             assert self.optimizer_initialized
-            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
-                f.write(self._updater.get_states(dump_optimizer=True))
+            from ..checkpoint.core import atomic_write_bytes
+            atomic_write_bytes("%s-%04d.states" % (prefix, epoch),
+                               self._updater.get_states(dump_optimizer=True))
 
     def load_optimizer_states(self, fname):
         """Reference: ``Module.load_optimizer_states``."""

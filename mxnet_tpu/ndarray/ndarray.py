@@ -973,8 +973,11 @@ _LIST_MAGIC = 0x112
 _ND_MAGIC = 0xF993FAC9
 
 
-def _save_one(f, arr: NDArray):
-    a = arr.asnumpy()
+def _save_one(f, arr):
+    # accepts host numpy arrays too: the checkpoint subsystem's async
+    # writer serializes device_get snapshots off-thread, and wrapping
+    # them back into NDArray would round-trip through the device
+    a = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
     f.write(struct.pack("<I", _ND_MAGIC))
     f.write(struct.pack("<i", 0))  # storage type: dense
     f.write(struct.pack("<I", a.ndim))
@@ -1009,8 +1012,15 @@ def _load_one(f) -> NDArray:
 
 
 def save(fname, data):
-    """Save NDArrays to the reference's ``.params`` container format
-    (reference: ``mx.nd.save`` / ``c_api.cc :: MXNDArraySave``)."""
+    """Save NDArrays (or host numpy arrays) to the reference's
+    ``.params`` container format (reference: ``mx.nd.save`` /
+    ``c_api.cc :: MXNDArraySave``).
+
+    This is the serialization *primitive*: it writes ``fname`` in
+    place.  State-checkpoint callers must wrap it in
+    ``mx.checkpoint.core.commit`` for torn-write safety (the
+    bare-state-write lint rule enforces this at call sites).
+    """
     if isinstance(data, NDArray):
         data, names = [data], []
     elif isinstance(data, dict):
@@ -1018,7 +1028,7 @@ def save(fname, data):
         data = [data[k] for k in names]
     else:
         data, names = list(data), []
-    with open(fname, "wb") as f:
+    with open(fname, "wb") as f:  # mxlint: disable=bare-state-write
         f.write(struct.pack("<Q", _LIST_MAGIC))
         f.write(struct.pack("<Q", 0))
         f.write(struct.pack("<Q", len(data)))

@@ -419,8 +419,8 @@ def export_model(sym, params, in_shapes=None, in_types=None,
             k = k.split(":", 1)[1]
         flat[k] = v
     model = _Exporter(sym, flat, in_shapes, in_types).run()
-    with open(onnx_file_path, "wb") as f:
-        f.write(model)
+    from ..checkpoint.core import atomic_write_bytes
+    atomic_write_bytes(onnx_file_path, model)
     return onnx_file_path
 
 

@@ -13,7 +13,12 @@ restores everything on restart.
 
 Checkpoint layout: ``<prefix>-preempt.params`` (block parameters) and
 ``<prefix>-preempt.states`` (Trainer/updater state), plus
-``<prefix>-preempt.meta`` (a tiny JSON with the step counter).
+``<prefix>-preempt.meta`` (JSON with the step counter AND the byte
+size + CRC32 of each committed file).  File commits go through the
+shared atomic helper (``mx.checkpoint.core.commit``); ``resume()``
+verifies the data files against the meta's checksums, so a checkpoint
+that bit-rotted (or was half-overwritten by an even older writer)
+reads as "no checkpoint" instead of loading garbage.
 """
 from __future__ import annotations
 
@@ -21,9 +26,11 @@ import json
 import os
 import signal
 import threading
+import warnings
 
 from . import telemetry as _telemetry
 from .base import MXNetError
+from .checkpoint import core as _ckpt
 
 __all__ = ["PreemptionHandler", "install", "resume"]
 
@@ -64,6 +71,10 @@ class PreemptionHandler:
         # RLock: the SIGTERM handler runs on the same thread and may
         # interrupt an explicit save_now() call mid-save
         self._lock = threading.RLock()
+        # a previous incarnation killed between write_fn(tmp) and
+        # os.replace strands its temp forever; clean house on arm
+        _ckpt.sweep_stale_tmps(os.path.dirname(self.prefix) or ".",
+                               prefix=os.path.basename(self.prefix))
         self._prev = {}
         for sig in signals:
             self._prev[sig] = signal.signal(sig, self._on_signal)
@@ -132,20 +143,31 @@ class PreemptionHandler:
                     except FileNotFoundError:
                         pass
 
-                def commit(path, write_fn):
-                    tmp = "%s.%d.tmp" % (path, os.getpid())
-                    write_fn(tmp)
-                    os.replace(tmp, path)
+                # shared atomic commit (tmp+fsync+rename) from the
+                # checkpoint subsystem; each commit's digest feeds the
+                # meta manifest that resume() verifies against
+                files = {}
 
-                commit(self.params_path, self.block.save_parameters)
+                def record(path, digest):
+                    files[os.path.basename(path)] = {
+                        "bytes": digest[0], "crc32": digest[1]}
+
+                record(self.params_path,
+                       _ckpt.commit(self.params_path,
+                                    self.block.save_parameters))
                 if self.trainer is not None:
-                    commit(self.states_path, self.trainer.save_states)
-                meta = {"step": step, "extra": self.extra_state}
+                    record(self.states_path,
+                           _ckpt.atomic_write_bytes(
+                               self.states_path,
+                               self.trainer.get_states()))
+                meta = {"step": step, "extra": self.extra_state,
+                        "format_version": _ckpt.FORMAT_VERSION,
+                        "files": files}
 
                 def write_meta(tmp):
                     with open(tmp, "w") as f:
                         json.dump(meta, f)
-                commit(self.meta_path, write_meta)
+                _ckpt.commit(self.meta_path, write_meta)
                 # only now: a failed write above leaves saved False so a
                 # later signal/save_now retries instead of silently
                 # skipping the one job this class has.  A provisional
@@ -220,14 +242,34 @@ def resume(prefix, block, trainer=None, ctx=None):
     states = prefix + "-preempt.states"
     meta_path = prefix + "-preempt.meta"
     # the meta file commits LAST in save_now: its presence proves the
-    # whole checkpoint landed (no truncated-params loads)
+    # whole checkpoint landed...
     if not os.path.exists(meta_path) or not os.path.exists(params):
         return None
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except ValueError:
+        warnings.warn("preemption meta %s is not valid JSON; treating "
+                      "as no checkpoint" % meta_path, RuntimeWarning)
+        return None
+    # ...and its checksums prove the files are the SAME bytes that were
+    # committed -- presence alone can't catch bit-rot or a stale params
+    # file beside a newer meta.  Metas from before the checkpoint
+    # subsystem carry no digests; those keep the legacy presence check.
+    files = meta.get("files")
+    if files:
+        problems = _ckpt.verify_files(os.path.dirname(prefix) or ".",
+                                      files)
+        if problems:
+            warnings.warn(
+                "preemption checkpoint %s failed verification (%s); "
+                "treating as no checkpoint" % (prefix,
+                                               "; ".join(problems)),
+                RuntimeWarning)
+            return None
     block.load_parameters(params, ctx=ctx)
     if trainer is not None and os.path.exists(states):
         trainer.load_states(states)
-    with open(meta_path) as f:
-        meta = json.load(f)
     if _telemetry._ENABLED:
         _telemetry.hooks.checkpoint("restore", prefix=prefix,
                                     step=meta.get("step"))

@@ -41,9 +41,18 @@ amp.overflow           event   fp16 grad overflow (scale halved)
 amp.overflows          counter total overflow steps
 amp.rescale            event   loss-scale growth after a clean window
 amp.loss_scale         gauge   current loss scale
-checkpoint             event   preemption checkpoint save/restore
+checkpoint             event   checkpoint save/restore (preemption
+                               handler + CheckpointManager), payload
+                               carries step/bytes/duration
 checkpoint.saves       counter saves (incl. provisional)
-checkpoint.restores    counter resumes from a preemption checkpoint
+checkpoint.restores    counter restores (preemption resume + manager)
+checkpoint.bytes_written counter bytes committed by saves
+checkpoint.bytes_read  counter bytes loaded by restores
+checkpoint.save_time   timer   wall time serializing+committing a save
+checkpoint.restore_time timer  wall time verifying+loading a restore
+checkpoint.async_wait  timer   time a save spent draining the previous
+                               in-flight async write (rivals step time
+                               => saving faster than the I/O)
 =====================  ======  =========================================
 """
 from __future__ import annotations
@@ -51,7 +60,7 @@ from __future__ import annotations
 __all__ = [
     "op_dispatch", "host_sync", "compile_event", "trainer_step",
     "samples_per_sec", "kv_op", "dataloader_wait", "amp_overflow",
-    "amp_rescale", "checkpoint",
+    "amp_rescale", "checkpoint", "checkpoint_wait",
 ]
 
 
@@ -137,7 +146,19 @@ def amp_rescale(scale_before, scale_after):
                                   scale_after=scale_after)
 
 
-def checkpoint(action, **payload):
+def checkpoint(action, nbytes=None, seconds=None, **payload):
     reg = _registry()
     reg.counter("checkpoint.%ss" % action).inc()
-    reg.event("checkpoint").emit(action=action, **payload)
+    if nbytes:
+        reg.counter("checkpoint.bytes_read" if action == "restore"
+                    else "checkpoint.bytes_written").inc(int(nbytes))
+    if seconds is not None:
+        reg.timer("checkpoint.%s_time" % action).observe(seconds)
+    reg.event("checkpoint").emit(action=action, nbytes=nbytes,
+                                 seconds=seconds, **payload)
+
+
+def checkpoint_wait(seconds, step=None):
+    reg = _registry()
+    reg.timer("checkpoint.async_wait").observe(
+        seconds, **({} if step is None else {"step": step}))

@@ -90,6 +90,47 @@ def test_tracer_branch_fires_and_clean_twin_silent():
     assert _lint(good) == []
 
 
+def test_bare_state_write_fires_in_save_paths():
+    bad = ("def save_states(self, fname):\n"
+           "    with open(fname, 'wb') as f:\n"
+           "        f.write(b'x')\n")
+    assert _rules_of(_lint(bad)) == ["bare-state-write"]
+    # keyword-mode spelling fires too
+    bad_kw = ("def export_model(path, blob):\n"
+              "    f = open(path, mode='wb')\n"
+              "    f.write(blob)\n")
+    assert _rules_of(_lint(bad_kw)) == ["bare-state-write"]
+
+
+def test_bare_state_write_clean_twins_silent():
+    # non-state function name: not a checkpoint path
+    ok_name = ("def append_log(fname):\n"
+               "    with open(fname, 'wb') as f:\n"
+               "        f.write(b'x')\n")
+    assert _lint(ok_name) == []
+    # reads and text writes in save paths are fine
+    ok_mode = ("def save_states(fname):\n"
+               "    with open(fname, 'rb') as f:\n"
+               "        return f.read()\n")
+    assert _lint(ok_mode) == []
+    # the atomic helper is what the rule demands
+    ok_helper = ("def save_states(fname, blob):\n"
+                 "    from mxnet_tpu.checkpoint.core import "
+                 "atomic_write_bytes\n"
+                 "    atomic_write_bytes(fname, blob)\n")
+    assert _lint(ok_helper) == []
+
+
+def test_bare_state_write_exempts_checkpoint_core():
+    src = ("def save_stage(fname):\n"
+           "    with open(fname, 'wb') as f:\n"
+           "        f.write(b'x')\n")
+    diags = an.lint_source(src, "mxnet_tpu/checkpoint/core.py")
+    assert diags == []
+    assert _rules_of(an.lint_source(src, "elsewhere.py")) == \
+        ["bare-state-write"]
+
+
 def test_suppression_comment_silences_rule():
     bad = "try:\n    pass\nexcept:  # mxlint: disable=bare-except\n    pass\n"
     assert _lint(bad) == []

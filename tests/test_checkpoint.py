@@ -1,0 +1,630 @@
+"""Checkpoint subsystem (ISSUE 3): atomic commit, manifest
+verification, corruption fallback, retention, async overlap, sharded
+save/reshard, and the rebased legacy save paths."""
+import json
+import os
+import subprocess
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.checkpoint import CheckpointError, CheckpointManager
+from mxnet_tpu.checkpoint import async_writer, core as ckpt_core
+
+from conftest import paired_params
+
+
+# ----------------------------------------------------------------------
+# helpers
+# ----------------------------------------------------------------------
+
+def _net_and_trainer():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9},
+                       kvstore=None)
+    return net, tr
+
+
+def _train(net, tr, x, y, steps, loss_fn=None):
+    loss_fn = loss_fn or gluon.loss.L2Loss()
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        tr.step(x.shape[0])
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    return (mx.nd.array(rng.randn(4, 6).astype(np.float32)),
+            mx.nd.array(rng.randn(4, 4).astype(np.float32)))
+
+
+def _dead_pid():
+    """A pid guaranteed dead: a subprocess that already exited."""
+    proc = subprocess.Popen(["true"])
+    proc.wait()
+    return proc.pid
+
+
+# ----------------------------------------------------------------------
+# manager round trip (acceptance: save -> kill -> restore resumes at
+# the saved step, params/optimizer state bit-identical)
+# ----------------------------------------------------------------------
+
+def test_manager_round_trip_bit_identical(tmp_path):
+    x, y = _data()
+    net, tr = _net_and_trainer()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    _train(net, tr, x, y, 5)
+    mgr.save_training(5, net, tr, metadata={"epoch": 1})
+
+    # "kill": brand-new objects, fresh manager over the same root
+    net2, tr2 = _net_and_trainer()
+    net2(x)  # materialize params
+    mgr2 = CheckpointManager(str(tmp_path / "ck"))
+    ckpt = mgr2.restore_training(net2, tr2)
+    assert ckpt.step == 5
+    assert ckpt.metadata == {"epoch": 1}
+    for p1, p2 in paired_params(net, net2):
+        np.testing.assert_array_equal(p1.data().asnumpy(),
+                                      p2.data().asnumpy())
+    # optimizer state (momentum) bit-identical => identical continuation
+    _train(net, tr, x, y, 1)
+    _train(net2, tr2, x, y, 1)
+    for p1, p2 in paired_params(net, net2):
+        np.testing.assert_array_equal(p1.data().asnumpy(),
+                                      p2.data().asnumpy())
+
+
+def test_restore_fresh_start_returns_none(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    assert mgr.restore() is None
+    assert mgr.latest_step() is None
+    net, tr = _net_and_trainer()
+    assert mgr.restore_training(net, tr) is None
+
+
+def test_generic_items_round_trip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    mgr.save(7, {"params": {"w": mx.nd.array(w)}, "blob": b"\x00state"},
+             metadata={"note": "x"})
+    ckpt = mgr.restore()
+    assert ckpt.step == 7
+    np.testing.assert_array_equal(ckpt.items["params"]["w"].asnumpy(), w)
+    assert ckpt.items["blob"] == b"\x00state"
+    assert ckpt.metadata == {"note": "x"}
+
+
+# ----------------------------------------------------------------------
+# corruption fallback (acceptance: survives an injected truncated-shard
+# corruption by falling back to the previous step)
+# ----------------------------------------------------------------------
+
+def _two_step_manager(tmp_path):
+    x, y = _data()
+    net, tr = _net_and_trainer()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    _train(net, tr, x, y, 1)
+    mgr.save_training(1, net, tr)
+    _train(net, tr, x, y, 1)
+    mgr.save_training(2, net, tr)
+    return mgr, net, tr, x, y
+
+
+def test_truncated_file_falls_back_to_previous_step(tmp_path):
+    mgr, net, tr, x, y = _two_step_manager(tmp_path)
+    with open(os.path.join(mgr.step_dir(2), "params.params"),
+              "r+b") as f:
+        f.truncate(10)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        assert mgr.latest_step() == 1
+    net2, tr2 = _net_and_trainer()
+    net2(x)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        ckpt = mgr.restore_training(net2, tr2)
+    assert ckpt.step == 1
+
+
+def test_missing_manifest_falls_back(tmp_path):
+    mgr, *_ = _two_step_manager(tmp_path)
+    os.remove(os.path.join(mgr.step_dir(2), ckpt_core.MANIFEST_NAME))
+    with pytest.warns(RuntimeWarning):
+        assert mgr.latest_step() == 1
+
+
+def test_bitflip_same_size_falls_back(tmp_path):
+    mgr, *_ = _two_step_manager(tmp_path)
+    fpath = os.path.join(mgr.step_dir(2), "trainer.bin")
+    with open(fpath, "r+b") as f:
+        f.seek(max(0, os.path.getsize(fpath) // 2))
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.warns(RuntimeWarning, match="crc32 mismatch"):
+        assert mgr.latest_step() == 1
+
+
+def test_explicit_restore_of_corrupt_step_raises(tmp_path):
+    mgr, *_ = _two_step_manager(tmp_path)
+    os.remove(os.path.join(mgr.step_dir(2), "params.params"))
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(CheckpointError):
+            mgr.restore(step=2)
+    # the good step still restores explicitly
+    assert mgr.restore(step=1).step == 1
+
+
+def test_all_steps_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    for s in (3, 1, 7):
+        mgr.save(s, {"blob": b"x"})
+    assert mgr.all_steps() == [1, 3, 7]
+    assert mgr.latest_step() == 7
+
+
+# ----------------------------------------------------------------------
+# retention
+# ----------------------------------------------------------------------
+
+def test_retention_max_to_keep(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    for s in range(1, 6):
+        mgr.save(s, {"blob": b"s%d" % s})
+    assert mgr.all_steps() == [4, 5]
+
+
+def test_retention_keep_every_n_interaction(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), max_to_keep=2,
+                            keep_every_n_steps=5)
+    for s in range(1, 13):
+        mgr.save(s, {"blob": b"s%d" % s})
+    # multiples of 5 immune to max_to_keep; last 2 others retained
+    assert mgr.all_steps() == [5, 10, 11, 12]
+
+
+def test_retention_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CKPT_MAX_TO_KEEP", "1")
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.max_to_keep == 1
+    for s in (1, 2, 3):
+        mgr.save(s, {"blob": b"x"})
+    assert mgr.all_steps() == [3]
+
+
+# ----------------------------------------------------------------------
+# stale-temp sweep (satellite)
+# ----------------------------------------------------------------------
+
+def test_sweep_stale_tmps_at_manager_init(tmp_path):
+    root = tmp_path / "ck"
+    root.mkdir()
+    dead = _dead_pid()
+    stale_file = root / ("step_00000001.%d.tmp" % dead)
+    stale_file.mkdir()          # a stranded staging DIR
+    (stale_file / "params.params").write_bytes(b"torn")
+    live_file = root / ("step_00000002.%d.tmp" % os.getpid())
+    live_file.mkdir()           # our own in-flight write: must survive
+    CheckpointManager(str(root))
+    assert not stale_file.exists()
+    assert live_file.exists()
+
+
+def test_commit_sweeps_sibling_stale_tmps(tmp_path):
+    dead = _dead_pid()
+    target = tmp_path / "state.bin"
+    stale = tmp_path / ("state.bin.%d.tmp" % dead)
+    stale.write_bytes(b"half-written")
+    ckpt_core.atomic_write_bytes(str(target), b"good")
+    assert target.read_bytes() == b"good"
+    assert not stale.exists()
+
+
+def test_commit_failure_leaves_no_tmp_and_old_file(tmp_path):
+    target = tmp_path / "state.bin"
+    target.write_bytes(b"old")
+
+    def boom(tmp):
+        with open(tmp, "wb") as f:
+            f.write(b"partial")
+        raise RuntimeError("writer died")
+
+    with pytest.raises(RuntimeError):
+        ckpt_core.commit(str(target), boom)
+    assert target.read_bytes() == b"old"
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+# ----------------------------------------------------------------------
+# async writer (acceptance: an async save returns to the training loop
+# before the bytes hit disk)
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def write_gate():
+    gate = threading.Event()
+    async_writer._TEST_WRITE_GATE = gate
+    yield gate
+    async_writer._TEST_WRITE_GATE = None
+
+
+def test_async_save_overlaps_training(tmp_path, write_gate):
+    x, y = _data()
+    net, tr = _net_and_trainer()
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    net(x)
+    mgr.save_training(1, net, tr)
+    # the writer is blocked on the gate: nothing committed yet...
+    assert mgr.all_steps() == []
+    assert mgr._writer.in_flight
+    # ...and the training loop advances regardless
+    _train(net, tr, x, y, 2)
+    assert mgr.all_steps() == []
+    write_gate.set()
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1]
+    assert mgr.restore().step == 1
+
+
+def test_async_snapshot_is_immutable_to_later_steps(tmp_path,
+                                                    write_gate):
+    x, y = _data()
+    net, tr = _net_and_trainer()
+    net(x)
+    before = {k: p._reduce().asnumpy() for k, p in
+              net._collect_params_with_prefix().items()}
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    mgr.save_training(1, net, tr)
+    _train(net, tr, x, y, 3)      # mutate params while save in flight
+    write_gate.set()
+    mgr.wait_until_finished()
+    ckpt = mgr.restore()
+    for k, v in before.items():
+        np.testing.assert_array_equal(ckpt.items["params"][k].asnumpy(),
+                                      v)
+
+
+def test_async_at_most_one_in_flight(tmp_path, write_gate):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    mgr.save(1, {"blob": b"one"})
+    done = threading.Event()
+
+    def second_save():
+        mgr.save(2, {"blob": b"two"})   # must drain save 1 first
+        done.set()
+
+    t = threading.Thread(target=second_save, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert not done.is_set()            # blocked behind save 1
+    assert mgr.all_steps() == []
+    write_gate.set()
+    t.join(timeout=30)
+    assert done.is_set()
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1, 2]
+
+
+def test_async_error_reraised_at_next_save(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    orig = mgr._write_step
+
+    def boom(*a, **k):
+        raise RuntimeError("disk on fire")
+
+    mgr._write_step = boom
+    mgr.save(1, {"blob": b"x"})         # fails on the writer thread
+    mgr._write_step = orig
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        mgr.save(2, {"blob": b"y"})
+    # the error is consumed: the SAME save retried now succeeds
+    mgr.save(2, {"blob": b"y"})
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [2]
+
+
+def test_async_error_reraised_at_wait(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    mgr._write_step = lambda *a, **k: (_ for _ in ()).throw(
+        OSError("enospc"))
+    mgr.save(1, {"blob": b"x"})
+    with pytest.raises(OSError, match="enospc"):
+        mgr.wait_until_finished()
+
+
+def test_async_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_CKPT_ASYNC", "1")
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr._writer is not None
+    mgr.save(1, {"blob": b"x"})
+    mgr.wait_until_finished()
+    assert mgr.all_steps() == [1]
+
+
+# ----------------------------------------------------------------------
+# sharded
+# ----------------------------------------------------------------------
+
+def _mesh_sharded_array():
+    mesh = mx.parallel.mesh.make_mesh({"dp": 8})
+    sh = NamedSharding(mesh, PartitionSpec("dp"))
+    return jax.device_put(np.arange(16, dtype=np.float32), sh), sh
+
+
+def test_sharded_round_trip_and_reshard(tmp_path):
+    arr, sh = _mesh_sharded_array()
+    mgr = CheckpointManager(str(tmp_path / "ck"), sharded=True)
+    mgr.save(5, {"params": {"emb": arr, "b": np.ones(3, np.float32)},
+                 "blob": b"opaque"}, metadata={"k": 1})
+    assert mgr.latest_step() == 5
+    manifest = ckpt_core.load_manifest(mgr.step_dir(5))
+    assert any(e["kind"] == "shard" for e in manifest["files"].values())
+    assert manifest["topology"]["num_devices"] == 8
+
+    # restore WITHOUT a mesh (host arrays): topology-independent
+    ckpt = mgr.restore()
+    np.testing.assert_array_equal(ckpt.items["params"]["emb"].asnumpy(),
+                                  np.arange(16))
+    np.testing.assert_array_equal(ckpt.items["params"]["b"].asnumpy(),
+                                  np.ones(3))
+    assert ckpt.items["blob"] == b"opaque"
+
+    # restore WITH a different sharding than saved: reshard-on-restore
+    mesh2 = mx.parallel.mesh.make_mesh({"dp": 4})
+    sh2 = NamedSharding(mesh2, PartitionSpec("dp"))
+    ckpt = mgr.restore(sharding=lambda item, key, shape:
+                       sh2 if key == "emb" else None)
+    emb = ckpt.items["params"]["emb"]._data
+    assert emb.sharding.num_devices == 4
+    np.testing.assert_array_equal(np.asarray(emb), np.arange(16))
+
+
+def test_sharded_corruption_falls_back(tmp_path):
+    arr, _ = _mesh_sharded_array()
+    mgr = CheckpointManager(str(tmp_path / "ck"), sharded=True)
+    mgr.save(1, {"params": {"emb": arr}})
+    mgr.save(2, {"params": {"emb": arr}})
+    shard = [f for f in os.listdir(mgr.step_dir(2))
+             if f.endswith(".params")][0]
+    with open(os.path.join(mgr.step_dir(2), shard), "r+b") as f:
+        f.truncate(4)
+    with pytest.warns(RuntimeWarning):
+        assert mgr.latest_step() == 1
+
+
+# ----------------------------------------------------------------------
+# rebased legacy paths (satellites)
+# ----------------------------------------------------------------------
+
+def test_trainer_save_states_atomic_on_failure(tmp_path):
+    x, y = _data()
+    net, tr = _net_and_trainer()
+    _train(net, tr, x, y, 1)
+    fname = str(tmp_path / "t.states")
+    tr.save_states(fname)
+    good = open(fname, "rb").read()
+    assert good
+
+    orig = tr._updater.get_states
+    tr._updater.get_states = lambda **kw: (_ for _ in ()).throw(
+        RuntimeError("serializer died"))
+    with pytest.raises(RuntimeError):
+        tr.save_states(fname)
+    tr._updater.get_states = orig
+    # old file intact, no tmp litter
+    assert open(fname, "rb").read() == good
+    assert [p for p in os.listdir(tmp_path)
+            if p.endswith(".tmp")] == []
+    # round trip still works
+    tr.load_states(fname)
+
+
+def test_kvstore_save_optimizer_states_atomic(tmp_path):
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    fname = str(tmp_path / "kv.states")
+    kv.save_optimizer_states(fname)
+    assert os.path.exists(fname)
+    assert [p for p in os.listdir(tmp_path)
+            if p.endswith(".tmp")] == []
+    kv.load_optimizer_states(fname)
+
+
+def test_model_save_checkpoint_atomic(tmp_path):
+    prefix = str(tmp_path / "m")
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    arg = {"fc_weight": mx.nd.ones((4, 6)), "fc_bias": mx.nd.zeros((4,))}
+    mx.model.save_checkpoint(prefix, 3, net, arg, {})
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    np.testing.assert_array_equal(arg2["fc_weight"].asnumpy(),
+                                  np.ones((4, 6)))
+    assert [p for p in os.listdir(tmp_path)
+            if p.endswith(".tmp")] == []
+
+
+def test_callback_managed_checkpoint(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    cb = mx.callback.managed_checkpoint(mgr, period=2,
+                                        metadata_fn=lambda i: {"it": i})
+    arg = {"w": mx.nd.ones((2, 2))}
+    cb(0, None, arg, {})            # epoch 1: period 2 -> no save
+    assert mgr.all_steps() == []
+    cb(1, None, arg, {})            # epoch 2 -> save
+    assert mgr.all_steps() == [2]
+    ckpt = mgr.restore()
+    np.testing.assert_array_equal(
+        ckpt.items["params"]["arg:w"].asnumpy(), np.ones((2, 2)))
+    assert ckpt.metadata == {"it": 1}
+
+
+# ----------------------------------------------------------------------
+# preemption rebase (satellite: resume verifies checksums)
+# ----------------------------------------------------------------------
+
+def test_preemption_meta_carries_digests(tmp_path):
+    x, _ = _data()
+    net, tr = _net_and_trainer()
+    net(x)
+    handler = mx.preemption.install(str(tmp_path / "job"), net, tr)
+    try:
+        handler.save_now(step=4)
+    finally:
+        handler.uninstall()
+    meta = json.load(open(handler.meta_path))
+    assert meta["step"] == 4
+    files = meta["files"]
+    assert set(files) == {os.path.basename(handler.params_path),
+                          os.path.basename(handler.states_path)}
+    for entry in files.values():
+        assert entry["bytes"] > 0 and isinstance(entry["crc32"], int)
+        assert 0 <= entry["crc32"] <= 0xFFFFFFFF
+
+
+def test_preemption_resume_rejects_corrupt_params(tmp_path):
+    x, _ = _data()
+    net, tr = _net_and_trainer()
+    net(x)
+    handler = mx.preemption.install(str(tmp_path / "job"), net, tr)
+    try:
+        handler.save_now(step=4)
+    finally:
+        handler.uninstall()
+    # bit-rot the params, keeping size (presence checks can't see this)
+    with open(handler.params_path, "r+b") as f:
+        f.seek(os.path.getsize(handler.params_path) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    net2, tr2 = _net_and_trainer()
+    net2(x)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        assert mx.preemption.resume(str(tmp_path / "job"),
+                                    net2, tr2) is None
+
+
+def test_preemption_resume_still_loads_good_checkpoint(tmp_path):
+    x, _ = _data()
+    net, tr = _net_and_trainer()
+    net(x)
+    handler = mx.preemption.install(str(tmp_path / "job"), net, tr)
+    try:
+        handler.save_now(step=9)
+    finally:
+        handler.uninstall()
+    net2, tr2 = _net_and_trainer()
+    net2(x)
+    meta = mx.preemption.resume(str(tmp_path / "job"), net2, tr2)
+    assert meta["step"] == 9
+    for p1, p2 in paired_params(net, net2):
+        np.testing.assert_array_equal(p1.data().asnumpy(),
+                                      p2.data().asnumpy())
+
+
+def test_preemption_resume_accepts_legacy_meta(tmp_path):
+    """Metas from before the subsystem (no 'files' key) keep loading."""
+    x, _ = _data()
+    net, tr = _net_and_trainer()
+    net(x)
+    handler = mx.preemption.install(str(tmp_path / "job"), net, tr)
+    try:
+        handler.save_now(step=2)
+    finally:
+        handler.uninstall()
+    meta = json.load(open(handler.meta_path))
+    del meta["files"]
+    with open(handler.meta_path, "w") as f:
+        json.dump(meta, f)
+    net2, tr2 = _net_and_trainer()
+    net2(x)
+    assert mx.preemption.resume(str(tmp_path / "job"),
+                                net2, tr2)["step"] == 2
+
+
+def test_preemption_install_sweeps_stale_tmps(tmp_path):
+    dead = _dead_pid()
+    stale = tmp_path / ("job-preempt.params.%d.tmp" % dead)
+    stale.write_bytes(b"torn")
+    unrelated = tmp_path / "other-file.params"
+    unrelated.write_bytes(b"keep me")
+    net, tr = _net_and_trainer()
+    handler = mx.preemption.install(str(tmp_path / "job"), net, tr)
+    handler.uninstall()
+    assert not stale.exists()
+    assert unrelated.exists()
+
+
+# ----------------------------------------------------------------------
+# telemetry wiring
+# ----------------------------------------------------------------------
+
+def test_manager_telemetry_events(tmp_path):
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(1, {"blob": b"0123456789"})
+        mgr.restore()
+        events = telemetry.event("checkpoint").recent
+        actions = [e["action"] for e in events]
+        assert actions == ["save", "restore"]
+        assert events[0]["nbytes"] == 10
+        assert events[0]["seconds"] >= 0
+        assert telemetry.counter("checkpoint.bytes_written").value == 10
+        assert telemetry.counter("checkpoint.bytes_read").value == 10
+        assert telemetry.timer("checkpoint.save_time").count == 1
+        assert telemetry.timer("checkpoint.restore_time").count == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_async_wait_timer_recorded(tmp_path, write_gate):
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+        mgr.save(1, {"blob": b"x"})
+        write_gate.set()
+        mgr.save(2, {"blob": b"y"})     # drains save 1 -> records wait
+        mgr.wait_until_finished()
+        assert telemetry.timer("checkpoint.async_wait").count >= 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ----------------------------------------------------------------------
+# misc API
+# ----------------------------------------------------------------------
+
+def test_save_rejects_bad_items(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with pytest.raises(CheckpointError):
+        mgr.save(1, {})
+    with pytest.raises(mx.base.MXNetError):
+        mgr.save(1, {"bad": 42})
+
+
+def test_resave_same_step_overwrites(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(1, {"blob": b"first"})
+    mgr.save(1, {"blob": b"second"})
+    assert mgr.all_steps() == [1]
+    assert mgr.restore().items["blob"] == b"second"
