@@ -485,49 +485,44 @@ def bench_pipeline(n=512, batch_size=64, threads=2):
 
 
 def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
-                       epochs=4, slab_batches=2):
+                       epochs=4, feed_depth=2):
     """End-to-end ResNet-50 training fed by the REAL input pipeline
     (raw-record uint8 decode through ImageIter), not synthetic tensors.
 
-    Double-buffered streaming staging (VERDICT r4 #3; reference:
-    ``iter_prefetcher.h``): a producer thread decodes slab k+1 and
-    issues its (async) ``jax.device_put`` while the compiled train step
-    consumes slab k, through a 2-deep queue.  Epoch 0 streams
-    decode -> stage -> train; staged slabs are retained on device
-    (uint8, on-device slice + cast per batch), so later epochs are
-    pure compute.  The timed window covers everything from the first
-    decoded record to the last step's sync.
+    The staging now runs on the LIBRARY path (ISSUE 4):
+    ``mxnet_tpu.dataio.DeviceFeed`` wraps the uint8 ImageIter -- a
+    background producer issues async ``jax.device_put`` through a
+    bounded double buffer while the compiled train step consumes the
+    previous batch, and the feed's jitted ``DeviceTransform`` casts
+    uint8 -> compute dtype after landing (reference:
+    ``iter_prefetcher.h``).  Epoch 0 streams decode -> stage -> train;
+    the compact staged batches (``DeviceBatch.raw``) are retained on
+    device, so later epochs are pure compute.  The timed window covers
+    everything from the first decoded record to the last step's sync.
 
     Returns ``(img/s, staging_overlap_frac)`` where the overlap
-    fraction is the share of producer (decode+transfer) time hidden
-    behind training compute: ``1 - consumer_wait / producer_busy``.
-    The axon tunnel's H2D throughput swings by orders of magnitude
-    (see the env_health line / docs/perf_resnet50.md); when transfers
-    dominate, the overlap fraction plus the health probe make the
-    bottleneck attributable in the artifact itself.
+    fraction -- the share of producer (decode+transfer) time hidden
+    behind training compute, ``1 - consumer_wait / producer_busy`` --
+    is computed from the library's ``feed.*`` telemetry instruments
+    (docs/observability.md), not bench-local accounting.  The axon
+    tunnel's H2D throughput swings by orders of magnitude (see the
+    env_health line / docs/perf_resnet50.md); when transfers dominate,
+    the overlap fraction plus the health probe make the bottleneck
+    attributable in the artifact itself.
     """
     import contextlib
-    import queue as queue_mod
     import shutil
     import tempfile
-    import threading
     import mxnet_tpu as mx
-    from mxnet_tpu import amp, gluon
+    from mxnet_tpu import amp, gluon, telemetry
+    from mxnet_tpu.dataio import DeviceFeed, DeviceTransform
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.image import ImageIter
     from mxnet_tpu.parallel import TrainStep
 
-    import jax
     import jax.numpy as jnp
     ctx = _ctx()
-    dev = jax.devices()[0] if mx.num_tpus() else jax.devices("cpu")[0]
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    pick = jax.jit(lambda s, i: jax.lax.dynamic_index_in_dim(
-        s, i, 0, keepdims=False).astype(compute_dtype))
-
-    n_batches = n_images // batch_size
-    n_slabs = max(1, n_batches // slab_batches)
-    sb = n_batches // n_slabs
 
     tmp = tempfile.mkdtemp(prefix="mxtpu_bench_e2e_")
     rec = _build_rec(_os.path.join(tmp, "train"), n_images, "raw")
@@ -545,74 +540,48 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
     amp_ctx = amp.scope(dtype) if dtype != "float32" \
         else contextlib.nullcontext()
 
-    slab_q = queue_mod.Queue(maxsize=2)   # double buffer
-    stats = {"produce": 0.0, "wait": 0.0}
+    it = ImageIter(batch_size, (3, 224, 224), path_imgrec=rec,
+                   preprocess_threads=0, dtype="uint8")
+    was_enabled = telemetry.enabled()
+    telemetry.enable()                 # source of the overlap fraction
+    telemetry.reset("feed.")
+    feed = DeviceFeed(it, ctx=ctx, depth=feed_depth,
+                      transform=DeviceTransform(dtype=dtype))
+    try:
+        with amp_ctx:
+            zx = mx.nd.NDArray(jnp.zeros((batch_size, 3, 224, 224),
+                                         jnp.uint8).astype(compute_dtype))
+            zy = mx.nd.NDArray(jnp.zeros((batch_size,), jnp.float32))
+            for _ in range(3):
+                step(zx, zy)
+            float(step(zx, zy).asscalar())
 
-    def producer():
-        it = ImageIter(batch_size, (3, 224, 224), path_imgrec=rec,
-                       preprocess_threads=0, dtype="uint8")
-        try:
-            it.reset()
-            for s in range(n_slabs):
-                t0 = time.perf_counter()
-                host = np.empty((sb, batch_size, 3, 224, 224), np.uint8)
-                lab = np.empty((sb, batch_size), np.float32)
-                for k in range(sb):
-                    _d, l, _pad = it.next_np(out=host[k])
-                    lab[k] = l
-                # async H2D: returns immediately, transfer proceeds
-                # while the consumer trains the previous slab
-                dslab = jax.device_put(host, dev)
-                dlab = jax.device_put(lab, dev)
-                stats["produce"] += time.perf_counter() - t0
-                slab_q.put((dslab, dlab))
-            slab_q.put(None)
-        except Exception as e:   # surface decode errors at the join
-            slab_q.put(e)
-        finally:
-            it.close()
-    with amp_ctx:
-        zx = mx.nd.NDArray(jnp.zeros((batch_size, 3, 224, 224),
-                                     jnp.uint8).astype(compute_dtype))
-        zy = mx.nd.NDArray(jnp.zeros((batch_size,), jnp.float32))
-        for _ in range(3):
-            step(zx, zy)
-        float(step(zx, zy).asscalar())
-
-        count = 0
-        last = None
-        staged = []
-        t_start = time.perf_counter()
-        th = threading.Thread(target=producer, daemon=True)
-        th.start()
-        while True:                       # epoch 0: streaming
-            t0 = time.perf_counter()
-            item = slab_q.get()
-            stats["wait"] += time.perf_counter() - t0
-            if item is None:
-                break
-            if isinstance(item, Exception):
-                raise item
-            dslab, dlab = item
-            staged.append((dslab, dlab))
-            for k in range(sb):
-                x = mx.nd.NDArray(pick(dslab, k))
-                y = mx.nd.NDArray(dlab[k])
-                last = step(x, y)
+            count = 0
+            last = None
+            staged = []
+            t_start = time.perf_counter()
+            for batch in feed:            # epoch 0: streaming
+                last = step(batch)
                 count += batch_size
-        for _ in range(epochs - 1):       # staged epochs: pure compute
-            for dslab, dlab in staged:
-                for k in range(sb):
-                    x = mx.nd.NDArray(pick(dslab, k))
-                    y = mx.nd.NDArray(dlab[k])
+                # retain the COMPACT (uint8) staged arrays, not the
+                # float expansion -- 4x less HBM
+                staged.append((batch.raw[0], batch.label))
+            for _ in range(epochs - 1):   # staged epochs: pure compute
+                for raw, y in staged:
+                    x = mx.nd.NDArray(feed.apply_transform(raw))
                     last = step(x, y)
                     count += batch_size
-        float(last.asscalar())
-        dt = time.perf_counter() - t_start
-    th.join()
-    shutil.rmtree(tmp, ignore_errors=True)
-    overlap = max(0.0, 1.0 - stats["wait"] / stats["produce"]) \
-        if stats["produce"] > 0 else 0.0
+            float(last.asscalar())
+            dt = time.perf_counter() - t_start
+        busy = telemetry.timer("feed.producer_busy").sum
+        wait = telemetry.timer("feed.consumer_wait").sum
+        overlap = max(0.0, 1.0 - wait / busy) if busy > 0 else 0.0
+    finally:
+        feed.close()
+        it.close()
+        if not was_enabled:
+            telemetry.disable()
+        shutil.rmtree(tmp, ignore_errors=True)
     return count / dt, round(overlap, 3)
 
 

@@ -4,8 +4,9 @@
 #   lint  -> compile-level sanity over the whole package
 #   suite -> full pytest run (8 virtual CPU devices, same as a PR gate)
 #   examples -> the runnable examples smoke-tested via their test file
-#   telemetry -> 3-step smoke train with the JSONL sink on, then the
-#                summarize CLI must report non-empty step/compile data
+#   telemetry -> 3-step smoke train (fed through mx.dataio.DeviceFeed)
+#                with the JSONL sink on, then the summarize CLI must
+#                report non-empty step/compile/feed data
 #   checkpoint -> save-every-step smoke train, simulated preemption
 #                 (kill-mid-write corruption of the newest step),
 #                 resume must fall back to the previous good step and
@@ -60,7 +61,10 @@ trainer = gluon.Trainer(net.collect_params(), "sgd",
 ds = gluon.data.ArrayDataset(
     mx.nd.array(np.random.rand(12, 8).astype(np.float32)),
     mx.nd.array(np.random.rand(12, 4).astype(np.float32)))
-loader = gluon.data.DataLoader(ds, batch_size=4)
+# the device-feed path (ISSUE 4): batches stage through
+# mx.dataio.DeviceFeed, so the summarize gate below can assert a
+# non-empty feed section alongside the host-loader instruments
+loader = gluon.data.DataLoader(ds, batch_size=4, ctx=mx.cpu())
 loss_fn = gluon.loss.L2Loss()
 for x, y in loader:                     # 3 steps
     with autograd.record():
@@ -82,9 +86,13 @@ assert agg["steps"]["count"] >= 3, agg["steps"]
 assert agg["compile"]["count"] > 0, agg["compile"]
 assert agg["kvstore"]["bytes"] > 0, agg["kvstore"]
 assert agg["data"]["batches"] >= 3, agg["data"]
-print("telemetry gate ok: %d steps, %d compiles, %d kv bytes"
+assert agg["feed"]["batches"] >= 3, agg["feed"]
+assert agg["feed"]["bytes_staged"] > 0, agg["feed"]
+assert agg["feed"]["producer_busy_s"] is not None, agg["feed"]
+print("telemetry gate ok: %d steps, %d compiles, %d kv bytes, "
+      "%d fed batches"
       % (agg["steps"]["count"], agg["compile"]["count"],
-         agg["kvstore"]["bytes"]))
+         agg["kvstore"]["bytes"], agg["feed"]["batches"]))
 EOF
     rm -f "$tjsonl" "$tjsonl.agg"
 }

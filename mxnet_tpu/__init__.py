@@ -114,6 +114,7 @@ from . import kvstore as kv
 from . import recordio
 from . import io
 from . import image
+from . import dataio
 from . import parallel
 from . import amp
 from . import model

@@ -1,0 +1,13 @@
+"""``mx.dataio`` -- the device-feed subsystem (docs/data_pipeline.md).
+
+Overlapped host->device staging for any batch source: a background
+thread issues async ``jax.device_put`` through a bounded double buffer
+so H2D DMA hides behind training compute, transfers ship compact
+dtypes, and a jitted on-device transform expands them after landing
+(reference analog: ``iter_prefetcher.h :: PrefetcherIter`` + the C++
+decode pipeline's engine-ordered copies).
+"""
+from .feed import DeviceBatch, DeviceFeed
+from .transforms import DeviceTransform
+
+__all__ = ["DeviceBatch", "DeviceFeed", "DeviceTransform"]

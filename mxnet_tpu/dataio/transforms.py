@@ -1,0 +1,97 @@
+"""Jitted on-device batch transforms for the device feed.
+
+The staging contract (docs/data_pipeline.md): batches cross the
+host->device wire in their COMPACT dtype (a uint8 image batch is 4x
+smaller than its float32 cast), and the decompression -- cast, scale,
+mean/std normalize, random mirror, random crop -- runs as one jitted
+XLA program on the device after the batch lands.  The reference does
+this work in C++ decode threads before the copy
+(``iter_image_recordio_2.cc``); on TPU the arithmetic is effectively
+free next to training compute while host->device bandwidth is the
+scarce resource, so the split goes the other way.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["DeviceTransform"]
+
+
+def _chan_const(v, ndim, chan_axis):
+    """Broadcastable (1, C, 1, ...) constant from a scalar or per-channel
+    sequence, for NCHW-style batches."""
+    a = jnp.asarray(np.asarray(v, np.float32))
+    if a.ndim == 0 or ndim is None:
+        return a
+    shape = [1] * ndim
+    shape[chan_axis] = a.shape[0]
+    return a.reshape(shape)
+
+
+class DeviceTransform:
+    """Compiled post-landing batch transform: ``transform(x, key)``.
+
+    Batches are NCHW (batch, channel, height, width) unless only the
+    dtype/scale/normalize stages are used, which are layout-agnostic.
+    Stage order: random crop -> random mirror (both on the compact
+    dtype) -> cast -> scale -> normalize, so the expensive float math
+    happens once, after the cheap integer-domain augmentation.
+
+    ``key`` is a ``jax.random`` PRNG key; it is consumed only when a
+    random stage (``rand_mirror``/``crop``) is configured, so a
+    deterministic transform compiles to a program that ignores it.
+    """
+
+    def __init__(self, dtype="float32", scale=None, mean=None, std=None,
+                 rand_mirror=False, crop=None, chan_axis=1):
+        self.dtype = np.dtype(dtype) if dtype is not None else None
+        self.scale = scale
+        self.rand_mirror = bool(rand_mirror)
+        self.crop = (crop, crop) if isinstance(crop, int) else \
+            (tuple(crop) if crop is not None else None)
+        self._chan_axis = chan_axis
+        self._mean = mean
+        self._std = std
+        self._fn = jax.jit(self._build())
+
+    def _build(self):
+        scale = self.scale
+        rand_mirror = self.rand_mirror
+        crop = self.crop
+        dtype = self.dtype
+        chan_axis = self._chan_axis
+        mean_v, std_v = self._mean, self._std
+
+        def fn(x, key):
+            k_crop, k_mirror = jax.random.split(key)
+            if crop is not None:
+                ch, cw = crop
+                y0 = jax.random.randint(k_crop, (), 0,
+                                        x.shape[-2] - ch + 1)
+                x0 = jax.random.randint(k_crop, (), 0,
+                                        x.shape[-1] - cw + 1)
+                starts = [jnp.zeros((), jnp.int32)] * (x.ndim - 2) \
+                    + [y0, x0]
+                x = jax.lax.dynamic_slice(
+                    x, starts, x.shape[:-2] + (ch, cw))
+            if rand_mirror:
+                flip = jax.random.bernoulli(k_mirror, 0.5, (x.shape[0],))
+                flip = flip.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+                x = jnp.where(flip, x[..., ::-1], x)
+            if dtype is not None:
+                x = x.astype(dtype)
+            if scale is not None:
+                x = x * jnp.asarray(scale, x.dtype)
+            if mean_v is not None:
+                x = x - _chan_const(mean_v, x.ndim, chan_axis).astype(x.dtype)
+            if std_v is not None:
+                x = x / _chan_const(std_v, x.ndim, chan_axis).astype(x.dtype)
+            return x
+
+        return fn
+
+    def __call__(self, x, key):
+        return self._fn(x, key)

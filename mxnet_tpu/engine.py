@@ -4,28 +4,42 @@ The reference's dependency engine (``src/engine/threaded_engine.cc``)
 schedules every mutation as an async op over versioned vars.  On TPU,
 XLA/PJRT's async runtime already provides dataflow ordering and async
 dispatch (SURVEY.md §1), so this module keeps only the *control surface*:
-sync points, a bulk scope (no-op: XLA fuses), and the naive-engine debug
-switch (eager blocking mode for race isolation).
+sync points, the bulk controls (wired to the bulked-eager region queue
+in ``ndarray/bulk.py``), and the naive-engine debug switch (eager
+blocking mode for race isolation).
 """
 from __future__ import annotations
 
 import contextlib
 import os
 
+from .ndarray import bulk as _bulk
 from .ndarray.ndarray import waitall  # re-export  # noqa: F401
 
 _blocking = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
 
 
 def set_bulk_size(size):
-    """Reference: ``mx.engine.set_bulk_size`` -- XLA fusion makes bulking
-    automatic; retained for API parity."""
-    return size
+    """Reference: ``mx.engine.set_bulk_size`` -- sets the max eager ops
+    per bulked region (the capacity-flush threshold of the bulked-eager
+    queue, ``ndarray/bulk.py``); returns the previous size.  ``size <=
+    1`` disables bulking, flushing any pending region first."""
+    return _bulk.set_bulk_size(size)
 
 
 @contextlib.contextmanager
 def bulk(size):
-    yield
+    """Bulk scope (reference: ``with mx.engine.bulk(size):``): eager ops
+    inside queue into regions of up to ``size`` ops that replay as one
+    jitted program; the pending region executes at scope exit (the
+    reference's bulk-segment boundary), then the previous bulk size is
+    restored."""
+    prev = _bulk.set_bulk_size(size)
+    try:
+        yield
+    finally:
+        _bulk.flush()
+        _bulk.set_bulk_size(prev)
 
 
 def is_blocking():

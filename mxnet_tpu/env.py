@@ -133,6 +133,20 @@ _VARS = [
            "(steps matching keep_every_n_steps are exempt).  0 keeps "
            "everything.  Per-manager override: "
            "CheckpointManager(max_to_keep=...)."),
+    EnvVar("MXNET_TPU_FEED_DEPTH", int, 2,
+           "Default bounded-queue depth of mx.dataio.DeviceFeed: how "
+           "many staged (device-resident) batches the background "
+           "producer may run ahead of the consumer.  2 = classic "
+           "double buffering; raise it when per-batch producer time is "
+           "bursty (decode spikes).  Per-feed override: "
+           "DeviceFeed(depth=...)."),
+    EnvVar("MXNET_TPU_FEED_COMPACT", bool, True,
+           "Ship feed batches host->device in their compact source "
+           "dtype (uint8 stays uint8 -- 4x less wire traffic than its "
+           "float32 cast) and expand on device via the feed's jitted "
+           "transform.  '0' pre-casts host-side to the transform's "
+           "target dtype before staging (A/B numerics debugging).  "
+           "Per-feed override: DeviceFeed(compact=...)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

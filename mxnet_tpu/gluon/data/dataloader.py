@@ -17,19 +17,31 @@ import time
 
 import numpy as np
 
+import jax
+
 from ... import telemetry as _telemetry
 from ...base import MXNetError
 from ...ndarray import NDArray, array
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "host_batchify_fn"]
+
+
+def _host_stack(data):
+    """Stack NDArray samples via ONE batched device fetch.
+
+    A per-sample ``asnumpy`` is a blocking device->host round-trip (and
+    a host-sync telemetry hit) for every element of the batch; a single
+    ``jax.device_get`` over all samples fetches them in one bulk
+    operation."""
+    return np.stack(jax.device_get([d._data for d in data]))
 
 
 def default_batchify_fn(data):
     """Stack samples into a batch (reference: ``default_batchify_fn``)."""
     if isinstance(data[0], NDArray):
-        return array(np.stack([d.asnumpy() for d in data]))
+        return array(_host_stack(data))
     if isinstance(data[0], (tuple, list)):
         return tuple(default_batchify_fn(list(x)) for x in zip(*data))
     arr = np.asarray(data)
@@ -38,13 +50,39 @@ def default_batchify_fn(data):
     return array(arr)
 
 
+def host_batchify_fn(data):
+    """Batchify that stays host-side numpy in the samples' compact dtype
+    (uint8 stays uint8) -- the device-feed path's default, so the ONLY
+    host->device transfer is the feed's async staging."""
+    if isinstance(data[0], NDArray):
+        return _host_stack(data)
+    if isinstance(data[0], (tuple, list)):
+        return tuple(host_batchify_fn(list(x)) for x in zip(*data))
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, ctx=None, mesh=None,
+                 sharding=None, device_transform=None, feed_depth=None):
         self._dataset = dataset
         self._timeout = timeout
+        # device-feed path (docs/data_pipeline.md): with a ctx/mesh/
+        # sharding, batches stay host numpy through batchify and a
+        # dataio.DeviceFeed stages them asynchronously; iteration then
+        # yields device-resident batches
+        self._feed_kw = None
+        if ctx is not None or mesh is not None or sharding is not None:
+            self._feed_kw = dict(ctx=ctx, mesh=mesh, sharding=sharding,
+                                 transform=device_transform,
+                                 depth=feed_depth)
+            if batchify_fn is None:
+                batchify_fn = host_batchify_fn
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError("batch_size required when no batch_sampler")
@@ -85,11 +123,28 @@ class DataLoader:
             yield batch
 
     def _iter_impl(self):
+        if self._feed_kw is not None:
+            yield from self._device_feed_iter()
+            return
+        yield from self._host_iter()
+
+    def _host_iter(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
         yield from self._threaded_iter()
+
+    def _device_feed_iter(self):
+        """Stage every host batch through a DeviceFeed; single-component
+        batches unwrap to the bare NDArray for host-path parity."""
+        from ...dataio import DeviceFeed
+        feed = DeviceFeed(self._host_iter(), **self._feed_kw)
+        try:
+            for batch in feed:
+                yield batch.data if len(batch) == 1 else batch
+        finally:
+            feed.close()
 
     def _threaded_iter(self):
         """Ordered thread-pool pipeline with bounded prefetch."""

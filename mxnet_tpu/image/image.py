@@ -561,3 +561,15 @@ class ImageIter:
                          pad=pad)
 
     next = __next__
+
+    def device_feed(self, ctx=None, mesh=None, sharding=None,
+                    transform=None, depth=None, compact=None):
+        """Wrap this iterator in a :class:`mxnet_tpu.dataio.DeviceFeed`:
+        decoded batches leave ``next_np`` as host numpy (in this iter's
+        dtype -- construct with ``dtype='uint8'`` for compact staging)
+        and a background thread overlaps the async host->device transfer
+        with the consumer's compute (docs/data_pipeline.md)."""
+        from ..dataio import DeviceFeed
+        return DeviceFeed(self, ctx=ctx, mesh=mesh, sharding=sharding,
+                          transform=transform, depth=depth,
+                          compact=compact)

@@ -264,14 +264,37 @@ def ImageRecordIter(path_imgrec=None, data_shape=None, batch_size=128,
                     shuffle=False, rand_crop=False, rand_mirror=False,
                     mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
                     num_parts=1, part_index=0, preprocess_threads=4,
-                    resize=0, **kwargs):
+                    resize=0, ctx=None, mesh=None, sharding=None,
+                    feed_depth=None, dtype="float32", **kwargs):
     """High-throughput record iterator (reference:
     ``iter_image_recordio_2.cc :: ImageRecordIOParser2``); threaded PIL
-    decode + augment + prefetch."""
-    from ..image import CreateAugmenter, ImageIter
+    decode + augment + prefetch.
+
+    With ``ctx``/``mesh``/``sharding`` the pipeline returns a
+    :class:`mxnet_tpu.dataio.DeviceFeed` instead of a host prefetcher:
+    decode+crop+mirror stay host-side on uint8, the batch ships compact
+    over the wire, and cast + mean/std normalization run as one jitted
+    program on the device after landing (docs/data_pipeline.md)."""
+    from ..image import CastAug, CreateAugmenter, ImageIter
 
     aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
                           rand_mirror=rand_mirror)
+    if ctx is not None or mesh is not None or sharding is not None:
+        from ..dataio import DeviceFeed, DeviceTransform
+        aug = [a for a in aug if not isinstance(a, CastAug)]
+        inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                          aug_list=aug, shuffle=shuffle,
+                          num_parts=num_parts, part_index=part_index,
+                          preprocess_threads=preprocess_threads,
+                          dtype="uint8")
+        mean_seq = (mean_r, mean_g, mean_b)
+        std_seq = (std_r or 1, std_g or 1, std_b or 1)
+        transform = DeviceTransform(
+            dtype=dtype,
+            mean=mean_seq if any(mean_seq) else None,
+            std=std_seq if any(s != 1 for s in std_seq) else None)
+        return DeviceFeed(inner, ctx=ctx, mesh=mesh, sharding=sharding,
+                          transform=transform, depth=feed_depth)
     inner = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
                       aug_list=aug, shuffle=shuffle, num_parts=num_parts,
                       part_index=part_index,

@@ -24,7 +24,8 @@ import threading
 import jax
 import numpy as np
 
-__all__ = ["LazyData", "enabled", "enqueue", "flush", "materialize"]
+__all__ = ["LazyData", "enabled", "enqueue", "flush", "materialize",
+           "set_bulk_size"]
 
 _ENABLED = os.environ.get("MXNET_TPU_EAGER_BULK", "1") != "0"
 # capacity flush: bounds host memory for loops that never sync
@@ -33,6 +34,27 @@ _MAX_PENDING = int(os.environ.get("MXNET_TPU_EAGER_BULK_MAX", "512"))
 
 def enabled():
     return _ENABLED
+
+
+def set_bulk_size(size):
+    """Set the capacity-flush threshold (max eager ops per bulked
+    region); returns the previous effective size (0 when bulking was
+    off).  ``size <= 1`` disables bulking after flushing any pending
+    region -- the runtime control surface behind
+    ``mx.engine.set_bulk_size`` / ``mx.engine.bulk`` (reference:
+    ``MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN``)."""
+    global _ENABLED, _MAX_PENDING
+    size = int(size)
+    with _LOCK:
+        prev = _MAX_PENDING if _ENABLED else 0
+        if size <= 1:
+            _ENABLED = False
+        else:
+            _ENABLED = True
+            _MAX_PENDING = size
+    if size <= 1:
+        flush()
+    return prev
 
 
 class LazyData:

@@ -481,9 +481,19 @@ class TrainStep:
             return None
 
     # -- call ----------------------------------------------------------
-    def __call__(self, data, label, batch_size=None):
+    def __call__(self, data, label=None, batch_size=None):
         from .. import autograd as _ag
         from ..ndarray import bulk as _bulk
+        if label is None:
+            # a fed batch (dataio.DeviceFeed) carries device-resident
+            # data+label; unpack without any re-transfer
+            from ..dataio import DeviceBatch
+            if isinstance(data, DeviceBatch):
+                data, label = data.data, data.label
+            if label is None:
+                raise MXNetError(
+                    "TrainStep needs (data, label) or a DeviceBatch "
+                    "with a label component")
         tr = self._trainer
         opt = tr._optimizer
         # value dtype must match the declared Parameter dtype BEFORE

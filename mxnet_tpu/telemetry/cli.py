@@ -136,6 +136,16 @@ def summarize_file(path):
             "wait_s": timers.get("data.wait_time", {}).get("sum"),
             "mean_wait_s": timers.get("data.wait_time", {}).get("mean"),
         },
+        "feed": {
+            "batches": counters.get("feed.batches", 0),
+            "bytes_staged": counters.get("feed.bytes_staged", 0),
+            "producer_busy_s": timers.get("feed.producer_busy",
+                                          {}).get("sum"),
+            "consumer_wait_s": timers.get("feed.consumer_wait",
+                                          {}).get("sum"),
+            "overlap_frac": gauges.get("feed.overlap_frac",
+                                       {}).get("value"),
+        },
     }
     return result
 
@@ -181,6 +191,15 @@ def _render_human(agg):
         lines.append("  input: %d batches, %.3fs waiting (mean %.1fms)"
                      % (da["batches"], da["wait_s"] or 0.0,
                         1e3 * (da["mean_wait_s"] or 0.0)))
+    fd = agg.get("feed", {})
+    if fd.get("batches"):
+        lines.append(
+            "  feed: %d batches, %d bytes staged, %.3fs producing / "
+            "%.3fs waiting%s"
+            % (fd["batches"], fd["bytes_staged"],
+               fd["producer_busy_s"] or 0.0, fd["consumer_wait_s"] or 0.0,
+               ", overlap %.1f%%" % (100 * fd["overlap_frac"])
+               if fd.get("overlap_frac") is not None else ""))
     lines.append("")
     lines.append(summary_table(_to_snapshot(agg)))
     return "\n".join(lines)

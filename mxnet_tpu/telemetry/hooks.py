@@ -37,6 +37,15 @@ kvstore.time           timer   wall time in pushpull (dispatch side)
 data.batches           counter batches produced by DataLoader
 data.wait_time         timer   consumer wait per batch (input
                                starvation when this rivals step_time)
+feed.batches           counter batches staged by dataio.DeviceFeed
+feed.bytes_staged      counter bytes shipped host->device by the feed
+feed.producer_busy     timer   per-batch producer time (host batch +
+                               async device_put issue)
+feed.consumer_wait     timer   per-batch consumer wait on the staging
+                               queue (transfer not hidden when this
+                               rivals producer_busy)
+feed.overlap_frac      gauge   per-epoch share of producer time hidden
+                               behind compute: 1 - wait/busy
 amp.overflow           event   fp16 grad overflow (scale halved)
 amp.overflows          counter total overflow steps
 amp.rescale            event   loss-scale growth after a clean window
@@ -59,8 +68,9 @@ from __future__ import annotations
 
 __all__ = [
     "op_dispatch", "host_sync", "compile_event", "trainer_step",
-    "samples_per_sec", "kv_op", "dataloader_wait", "amp_overflow",
-    "amp_rescale", "checkpoint", "checkpoint_wait",
+    "samples_per_sec", "kv_op", "dataloader_wait", "feed_produce",
+    "feed_wait", "feed_overlap", "amp_overflow", "amp_rescale",
+    "checkpoint", "checkpoint_wait",
 ]
 
 
@@ -129,6 +139,22 @@ def dataloader_wait(seconds):
     reg = _registry()
     reg.counter("data.batches").inc()
     reg.timer("data.wait_time").observe(seconds)
+
+
+def feed_produce(seconds, nbytes):
+    reg = _registry()
+    reg.counter("feed.batches").inc()
+    if nbytes:
+        reg.counter("feed.bytes_staged").inc(int(nbytes))
+    reg.timer("feed.producer_busy").observe(seconds)
+
+
+def feed_wait(seconds):
+    _registry().timer("feed.consumer_wait").observe(seconds)
+
+
+def feed_overlap(frac):
+    _registry().gauge("feed.overlap_frac").set(frac)
 
 
 def amp_overflow(scale_before, scale_after):

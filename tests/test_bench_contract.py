@@ -84,6 +84,19 @@ def test_budget_exhaustion_skips_garnish_only(bench_mod, capsys,
                         "resnet50_imagenet_train", "env_health"}
 
 
+def test_e2e_runs_on_library_device_feed(bench_mod):
+    """ISSUE 4: the e2e config must measure the PRODUCT's staging path
+    (mxnet_tpu.dataio.DeviceFeed), not bench-local scaffolding -- no
+    private producer thread, no hand-rolled slab queue, and the overlap
+    fraction must come from the feed.* telemetry instruments."""
+    import inspect
+    src = inspect.getsource(bench_mod.bench_resnet50_e2e)
+    assert "DeviceFeed" in src
+    assert "threading.Thread" not in src
+    assert "slab_q" not in src
+    assert "feed.producer_busy" in src and "feed.consumer_wait" in src
+
+
 def test_scan_failure_falls_back_for_headline(bench_mod, capsys,
                                               monkeypatch):
     def boom(*a, **k):

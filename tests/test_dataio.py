@@ -1,0 +1,328 @@
+"""Device-feed pipeline (ISSUE 4): ``mxnet_tpu.dataio.DeviceFeed`` --
+overlapped host->device staging, on-device transforms, error/shutdown
+semantics, and the integration paths (DataLoader ctx, ImageRecordIter
+ctx, TrainStep fed batches, engine bulk wiring, batchify one-gather)."""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, recordio, telemetry
+from mxnet_tpu.dataio import DeviceBatch, DeviceFeed, DeviceTransform
+
+
+def _src(n, shape=(4, 3), dtype=np.float32, decode_s=0.0, fail_at=None):
+    for i in range(n):
+        if decode_s:
+            time.sleep(decode_s)
+        if fail_at is not None and i == fail_at:
+            raise ValueError("decode blew up at %d" % i)
+        yield (np.full(shape, i, dtype), np.full((shape[0],), i,
+                                                 np.float32))
+
+
+# -- core semantics ----------------------------------------------------
+
+def test_ordering_under_prefetch_depth():
+    feed = DeviceFeed(_src(10), ctx=mx.cpu(), depth=4)
+    seen = [float(b.data.asnumpy()[0, 0]) for b in feed]
+    assert seen == [float(i) for i in range(10)]
+
+
+def test_yields_device_batches():
+    feed = DeviceFeed(_src(2), ctx=mx.cpu())
+    b = next(feed)
+    assert isinstance(b, DeviceBatch)
+    assert isinstance(b.data, mx.nd.NDArray)
+    assert b.label.shape == (4,)
+    x, y = b                     # tuple-style unpack
+    assert x is b.data and y is b.label
+    assert b[0] is b.data and len(b) == 2
+    feed.close()
+
+
+def test_producer_exception_reraises_at_next():
+    feed = DeviceFeed(_src(10, fail_at=2), ctx=mx.cpu())
+    next(feed)
+    next(feed)
+    with pytest.raises(ValueError, match="decode blew up"):
+        next(feed)
+    # the error sticks: every later next() re-raises (checkpoint/bulk
+    # captured-exception precedent), and the producer thread is gone
+    with pytest.raises(ValueError):
+        next(feed)
+    assert feed._thread is None
+
+
+def test_clean_close_mid_epoch():
+    feed = DeviceFeed(_src(100), ctx=mx.cpu(), depth=2)
+    next(feed)
+    th = feed._thread
+    feed.close()
+    assert not th.is_alive()
+    feed.close()                 # idempotent
+
+
+def test_no_leaked_thread_between_epochs():
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    it = io.NDArrayIter(x, x[:, 0], batch_size=4)
+    feed = DeviceFeed(it, ctx=mx.cpu())
+    assert len(list(feed)) == 3
+    assert feed._thread is None          # epoch end joined the producer
+    feed.reset()
+    assert len(list(feed)) == 3          # epoch 2 identical
+    assert feed._thread is None
+
+
+def test_uint8_stage_plus_device_cast_matches_host_cast():
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, (6, 3, 5, 5), np.uint8)
+    mean, std = (10.0, 20.0, 30.0), (2.0, 3.0, 4.0)
+    tf = DeviceTransform(dtype="float32", mean=mean, std=std)
+    feed = DeviceFeed(iter([(raw,)]), ctx=mx.cpu(), transform=tf)
+    b = next(feed)
+    # the wire format stayed compact ...
+    assert b.raw[0].dtype == np.uint8
+    # ... and the on-device expansion equals the host-side float math
+    host = (raw.astype(np.float32)
+            - np.asarray(mean, np.float32).reshape(1, 3, 1, 1)) \
+        / np.asarray(std, np.float32).reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(b.data.asnumpy(), host, rtol=1e-6)
+    feed.close()
+
+
+def test_compact_off_precasts_host_side():
+    raw = np.arange(12, dtype=np.uint8).reshape(1, 12)
+    tf = DeviceTransform(dtype="float32")
+    feed = DeviceFeed(iter([(raw,)]), ctx=mx.cpu(), transform=tf,
+                      compact=False)
+    b = next(feed)
+    assert b.raw[0].dtype == np.float32  # fat wire format, by request
+    np.testing.assert_allclose(b.data.asnumpy(), raw.astype(np.float32))
+    feed.close()
+
+
+def test_overlap_positive_on_threaded_path():
+    """Acceptance gate: with real producer work overlapped against a
+    slower consumer, consumer wait < producer busy, so the overlap
+    fraction is strictly positive."""
+    feed = DeviceFeed(_src(6, decode_s=0.01), ctx=mx.cpu(), depth=2)
+    for _ in feed:
+        time.sleep(0.03)         # stand-in for training compute
+    s = feed.stats()
+    assert s["batches"] == 6
+    assert s["consumer_wait"] < s["producer_busy"]
+    assert feed.overlap_frac() > 0
+
+
+def test_feed_telemetry_instruments():
+    telemetry.enable()
+    try:
+        telemetry.reset("feed.")
+        feed = DeviceFeed(_src(3), ctx=mx.cpu())
+        list(feed)
+        assert telemetry.counter("feed.batches").value == 3
+        assert telemetry.counter("feed.bytes_staged").value > 0
+        assert telemetry.timer("feed.producer_busy").count == 3
+        assert telemetry.timer("feed.consumer_wait").count >= 3
+        assert telemetry.gauge("feed.overlap_frac").value is not None
+    finally:
+        telemetry.disable()
+
+
+def test_random_transform_stages():
+    rng = np.random.RandomState(1)
+    raw = rng.randint(0, 256, (4, 3, 10, 10), np.uint8)
+    tf = DeviceTransform(dtype="float32", rand_mirror=True, crop=(8, 8))
+    feed = DeviceFeed(iter([(raw,)]), ctx=mx.cpu(), transform=tf)
+    b = next(feed)
+    assert b.data.shape == (4, 3, 8, 8)
+    out = b.data.asnumpy()
+    # every output row must be a crop of the input, mirrored or not
+    found = 0
+    for i in range(4):
+        for y0 in range(3):
+            for x0 in range(3):
+                win = raw[i, :, y0:y0 + 8, x0:x0 + 8].astype(np.float32)
+                if np.array_equal(out[i], win) or \
+                        np.array_equal(out[i], win[..., ::-1]):
+                    found += 1
+                    break
+            else:
+                continue
+            break
+    assert found == 4
+    feed.close()
+
+
+def test_mesh_sharded_staging():
+    devs = jax.devices("cpu")
+    if len(devs) < 2:
+        pytest.skip("needs multiple virtual devices")
+    mesh = jax.sharding.Mesh(np.array(devs), ("dp",))
+    feed = DeviceFeed(_src(2, shape=(len(devs) * 2, 3)), mesh=mesh)
+    b = next(feed)
+    sh = b.data._data.sharding
+    assert isinstance(sh, jax.sharding.NamedSharding)
+    assert sh.spec[0] == "dp"
+    assert len(b.data._data.devices()) == len(devs)
+    feed.close()
+
+
+def test_already_resident_batch_not_retransferred():
+    x = mx.nd.ones((2, 2), ctx=mx.cpu())
+    feed = DeviceFeed(iter([(x,)]), ctx=mx.cpu())
+    b = next(feed)
+    assert b.raw[0] is x._data          # same buffer, no copy
+    assert feed.stats()["bytes_staged"] == 0
+    feed.close()
+
+
+# -- integration paths -------------------------------------------------
+
+def test_dataloader_ctx_path_matches_host_path():
+    X = np.random.RandomState(0).rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(X, y)
+    host = [(x.asnumpy(), l.asnumpy())
+            for x, l in gluon.data.DataLoader(ds, batch_size=4)]
+    fed = [(x.asnumpy(), l.asnumpy())
+           for x, l in gluon.data.DataLoader(ds, batch_size=4,
+                                             ctx=mx.cpu())]
+    assert len(host) == len(fed) == 3
+    for (hx, hl), (fx, fl) in zip(host, fed):
+        np.testing.assert_array_equal(hx, fx)
+        np.testing.assert_array_equal(hl, fl)
+
+
+def test_dataloader_ctx_path_workers_and_reiter():
+    ds = gluon.data.ArrayDataset(np.arange(16, dtype=np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                                   ctx=mx.cpu())
+    for _ in range(2):                   # re-iteration = fresh feed
+        out = np.concatenate([b.asnumpy() for b in loader])
+        np.testing.assert_array_equal(out,
+                                      np.arange(16, dtype=np.float32))
+
+
+def _make_rec(tmp_path, n=8, hw=(28, 30)):
+    prefix = str(tmp_path / "ds")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, hw + (3,), dtype=np.uint8)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img))
+    rec.close()
+    return prefix
+
+
+def test_image_record_iter_ctx_path(tmp_path):
+    prefix = _make_rec(tmp_path)
+    kw = dict(path_imgrec=prefix + ".rec", data_shape=(3, 24, 24),
+              batch_size=4, mean_r=128, mean_g=128, mean_b=128,
+              std_r=2, std_g=2, std_b=2, preprocess_threads=0)
+    host = [b.data[0].asnumpy() for b in io.ImageRecordIter(**kw)]
+    feed = io.ImageRecordIter(ctx=mx.cpu(), **kw)
+    assert isinstance(feed, DeviceFeed)
+    fed = []
+    for b in feed:
+        assert b.raw[0].dtype == np.uint8    # compact over the wire
+        assert b.data.dtype == np.float32
+        fed.append(b.data.asnumpy())
+    assert len(fed) == len(host) == 2
+    for h, f in zip(host, fed):
+        np.testing.assert_allclose(h, f, rtol=1e-5)
+
+
+def test_image_iter_device_feed_method(tmp_path):
+    from mxnet_tpu.image import ImageIter
+    prefix = _make_rec(tmp_path)
+    it = ImageIter(4, (3, 24, 24), path_imgrec=prefix + ".rec",
+                   preprocess_threads=0, dtype="uint8")
+    with it:
+        feed = it.device_feed(ctx=mx.cpu(),
+                              transform=DeviceTransform(dtype="float32"))
+        batches = list(feed)
+        assert len(batches) == 2
+        assert batches[0].data.dtype == np.float32
+        assert batches[0].label.shape == (4,)
+
+
+def test_trainstep_accepts_fed_batch():
+    from mxnet_tpu.parallel import TrainStep
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), trainer, mesh=None)
+    src = iter([(np.ones((4, 3), np.float32), np.ones((4, 2), np.float32))
+                for _ in range(2)])
+    feed = DeviceFeed(src, ctx=mx.cpu())
+    losses = [float(step(b).asscalar()) for b in feed]
+    assert len(losses) == 2 and all(np.isfinite(losses))
+    with pytest.raises(mx.MXNetError):
+        step(mx.nd.ones((4, 3)))          # bare data without a label
+
+
+# -- satellite: batchify single-gather ---------------------------------
+
+def test_default_batchify_one_bulk_gather():
+    from mxnet_tpu.gluon.data.dataloader import default_batchify_fn
+    samples = [mx.nd.array(np.full((3,), i, np.float32))
+               for i in range(8)]
+    telemetry.enable()
+    try:
+        telemetry.reset("dispatch.host_sync")
+        out = default_batchify_fn(samples)
+        # one batched device_get, zero per-sample asnumpy round-trips
+        assert telemetry.counter("dispatch.host_sync").value == 0
+    finally:
+        telemetry.disable()
+    assert out.shape == (8, 3)
+    np.testing.assert_array_equal(out.asnumpy()[:, 0],
+                                  np.arange(8, dtype=np.float32))
+
+
+def test_host_batchify_keeps_numpy_compact():
+    from mxnet_tpu.gluon.data.dataloader import host_batchify_fn
+    out = host_batchify_fn([np.full((2,), i, np.uint8) for i in range(4)])
+    assert isinstance(out, np.ndarray) and out.dtype == np.uint8
+    pair = host_batchify_fn([(np.ones(2, np.uint8), 1.0),
+                             (np.zeros(2, np.uint8), 2.0)])
+    assert pair[0].dtype == np.uint8
+    assert pair[1].dtype == np.float32   # float64 scalars compact too
+
+
+# -- satellite: engine bulk wiring -------------------------------------
+
+def test_engine_set_bulk_size_wired():
+    from mxnet_tpu import engine
+    from mxnet_tpu.ndarray import bulk
+    prev = engine.set_bulk_size(7)
+    try:
+        assert bulk._MAX_PENDING == 7 and bulk.enabled()
+        assert engine.set_bulk_size(9) == 7
+        assert engine.set_bulk_size(1) == 9   # <=1 disables
+        assert not bulk.enabled()
+    finally:
+        engine.set_bulk_size(prev if prev else 1)
+    assert bulk.enabled() == bool(prev)
+
+
+def test_engine_bulk_scope_executes_and_restores():
+    from mxnet_tpu import engine
+    from mxnet_tpu.ndarray import bulk
+    before = (bulk._MAX_PENDING, bulk.enabled())
+    with engine.bulk(3):
+        assert bulk._MAX_PENDING == 3 and bulk.enabled()
+        a = mx.nd.ones((2, 2))
+        c = (a + 1) * 2
+    assert (bulk._MAX_PENDING, bulk.enabled()) == before
+    assert c.asnumpy()[0, 0] == 4.0
