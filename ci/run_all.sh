@@ -11,6 +11,9 @@
 #                 (kill-mid-write corruption of the newest step),
 #                 resume must fall back to the previous good step and
 #                 the telemetry JSONL must record the restore event
+#   tsan -> threaded smoke train + the threaded test files under
+#           MXNET_TPU_TSAN=1 (lock-order sanitizer + deadlock watchdog
+#           armed), including the injected-deadlock fixtures
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -19,7 +22,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -27,10 +30,16 @@ run_lint() {
     log "lint: byte-compile every source file"
     python -m compileall -q mxnet_tpu tools benchmark bench.py \
         __graft_entry__.py
-    log "lint: mxnet_tpu.analysis self-check (trace-safety linter + retrace audit)"
-    # the same pass developers run locally as `mxlint` -- CI and the CLI
-    # cannot drift (docs/analysis.md); exits non-zero on any violation,
-    # --json keeps the record machine-readable for the gate log
+    log "lint: incremental pass (changed files vs committed baseline)"
+    # the pre-commit-speed path: only `git diff` files are linted and
+    # findings recorded in the committed baseline stay suppressed, so
+    # this stage stays fast as the rule count grows (docs/analysis.md)
+    python -m mxnet_tpu.analysis --changed \
+        --baseline ci/lint_baseline.json --json
+    log "lint: mxnet_tpu.analysis full self-check (trace safety + concurrency + retrace audit)"
+    # the authoritative gate, same pass developers run as `mxlint
+    # --self` -- CI and the CLI cannot drift; exits non-zero on any
+    # violation, --json keeps the record machine-readable
     python -m mxnet_tpu.analysis --self --json
 }
 
@@ -188,6 +197,52 @@ print("checkpoint gate ok: %d saves, %d restores recorded"
       % (actions.count("save"), actions.count("restore")))
 EOF
     rm -rf "$ckdir"
+}
+
+run_tsan() {
+    log "tsan: threaded smoke train under the concurrency sanitizer"
+    # same shape as the telemetry smoke train, but with the lock-order
+    # sanitizer + deadlock watchdog armed: a silent A/B inversion or a
+    # stuck producer raises here instead of hanging a real run
+    JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
+        MXNET_TPU_TELEMETRY=1 python - <<'EOF'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, sync, telemetry
+
+assert sync.tsan_enabled(), "MXNET_TPU_TSAN=1 did not arm the sanitizer"
+seeded = sync.seed_static_order()
+net = gluon.nn.Dense(4)
+net.initialize()
+net.hybridize()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.1})
+ds = gluon.data.ArrayDataset(
+    mx.nd.array(np.random.rand(16, 8).astype(np.float32)),
+    mx.nd.array(np.random.rand(16, 4).astype(np.float32)))
+# threaded end to end: worker-pool decode + DeviceFeed staging
+loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                               ctx=mx.cpu())
+loss_fn = gluon.loss.L2Loss()
+for x, y in loader:                     # 4 steps
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(4)
+loss.asnumpy()
+assert not sync.recorded_reports(), sync.recorded_reports()
+print("tsan smoke train ok: %d steps, %d static edges seeded, "
+      "order graph %r"
+      % (telemetry.counter("trainer.steps").value, seeded,
+         sync.order_graph()))
+EOF
+    log "tsan: threaded test files under MXNET_TPU_TSAN=1"
+    # the tier-1 threaded suites must stay green with the sanitizer
+    # armed, and tests/test_sync.py carries the injected-deadlock
+    # fixture the watchdog must catch with a both-stacks report
+    JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
+        python -m pytest tests/test_sync.py tests/test_dataio.py \
+        tests/test_checkpoint.py tests/test_telemetry.py -q
 }
 
 run_bench() {

@@ -91,6 +91,7 @@ if _os.environ.get("MXNET_TPU_COMPILATION_CACHE", "1") != "0":
 
 from . import base
 from .base import MXNetError
+from . import sync
 from . import telemetry
 from .context import (Context, cpu, cpu_pinned, current_context, gpu,
                       num_gpus, num_tpus, tpu)

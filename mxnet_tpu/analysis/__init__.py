@@ -16,6 +16,12 @@ device time is spent (docs/analysis.md):
   the ISSUE 3 torn-write guard).
 - :func:`audit_retrace` -- cross-reference op param specs with the
   compile-cache keys to flag unbounded-recompilation hazards.
+- :func:`audit_lock_order` / the concurrency rules -- inventory every
+  lock/condition/event, build the acquisition-order graph from nested
+  ``with lock:`` scopes (cycle => ``lock-order-inversion``), and check
+  thread discipline (``unguarded-shared-write``, ``blocking-under-lock``,
+  ``bare-thread``, ``sleep-poll``).  Runtime closure:
+  ``MXNET_TPU_TSAN=1`` (``mxnet_tpu.sync``, docs/concurrency.md).
 
 CLI: ``python -m mxnet_tpu.analysis`` (or the ``mxlint`` entry point);
 ``ci/run_all.sh lint`` runs it with ``--self``.  Add a rule with
@@ -26,6 +32,7 @@ from .core import (Diagnostic, Rule, RULES, rule, get_rule, list_rules,
 from .graph_check import GraphCheckError, assert_graph_ok, check_symbol
 from .trace_lint import lint_file, lint_paths, lint_source
 from . import state_write  # noqa: F401  (registers bare-state-write)
+from .concurrency import audit_lock_order, static_order_edges
 from .retrace import audit_retrace
 from .cli import main
 
@@ -34,5 +41,5 @@ __all__ = [
     "render_human", "render_json", "ERROR", "WARNING",
     "GraphCheckError", "assert_graph_ok", "check_symbol",
     "lint_file", "lint_paths", "lint_source",
-    "audit_retrace", "main",
+    "audit_lock_order", "static_order_edges", "audit_retrace", "main",
 ]

@@ -1,13 +1,22 @@
 """``mxlint`` / ``python -m mxnet_tpu.analysis`` -- the one CLI over
-all three analysis passes.
+all the analysis passes.
 
 Exit status: 1 when any error-severity diagnostic survives suppression
 (warnings too under ``--strict``), else 0 -- so CI gates on the exit
 code and consumes ``--json`` for reporting.
+
+Incremental mode (ISSUE 5 satellite): ``--changed`` lints only files
+``git diff`` names (worktree vs HEAD, falling back to the last commit),
+and ``--baseline snapshot.json`` suppresses findings recorded by a
+previous ``--write-baseline`` run -- so pre-commit and the CI lint
+stage stay fast and quiet as the rule count grows, while ``--self``
+remains the authoritative full gate.
 """
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from typing import List
 
@@ -24,13 +33,26 @@ def _build_parser():
     ap = argparse.ArgumentParser(
         prog="mxlint",
         description="Static graph checker + trace-safety linter + "
-                    "retrace auditor for mxnet_tpu (docs/analysis.md).")
+                    "concurrency sanitizer + retrace auditor for "
+                    "mxnet_tpu (docs/analysis.md).")
     ap.add_argument("paths", nargs="*",
-                    help="files or directories to trace-lint")
+                    help="files or directories to lint")
     ap.add_argument("--self", dest="self_check", action="store_true",
                     help="lint the repository itself (%s) and run the "
-                         "retrace audit -- the CI lint gate"
+                         "retrace audit -- the full CI lint gate"
                          % " ".join(SELF_PATHS))
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files `git diff --name-only` "
+                         "reports (worktree vs HEAD, else the last "
+                         "commit); lock-order analysis still builds "
+                         "the full-tree graph but reports only into "
+                         "changed files")
+    ap.add_argument("--baseline", metavar="JSON",
+                    help="suppress findings recorded in this snapshot "
+                         "(see --write-baseline)")
+    ap.add_argument("--write-baseline", metavar="JSON",
+                    help="write surviving findings as a baseline "
+                         "snapshot and exit 0")
     ap.add_argument("--graph", action="append", default=[],
                     metavar="SYMBOL_JSON",
                     help="run the static graph checker over a saved "
@@ -64,15 +86,63 @@ def _parse_shapes(specs) -> dict:
 def _list_rules() -> str:
     lines = []
     for r in sorted(RULES.values(), key=lambda r: (r.kind, r.id)):
-        lines.append("%-20s %-9s %-8s %s"
+        lines.append("%-22s %-9s %-8s %s"
                      % (r.id, r.kind, r.severity, r.doc))
     return "\n".join(lines)
+
+
+def _git_changed_files() -> List[str]:
+    """Python files the working tree changed vs HEAD; when the tree is
+    clean (CI on a fresh checkout), the files of the last commit."""
+    def run(*args):
+        try:
+            out = subprocess.run(["git"] + list(args),
+                                 capture_output=True, text=True,
+                                 timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if out.returncode != 0:
+            return []
+        return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+    files = run("diff", "--name-only", "HEAD")
+    files += run("ls-files", "--others", "--exclude-standard")
+    if not files:
+        # a clean tree (CI on a fresh checkout): the last commit's
+        # files; diff-tree also handles the root commit
+        files = run("diff-tree", "--no-commit-id", "--name-only", "-r",
+                    "--root", "HEAD")
+    import os
+    return sorted({f for f in files
+                   if f.endswith(".py") and os.path.exists(f)})
+
+
+def _baseline_key(d: Diagnostic) -> tuple:
+    # line numbers shift on unrelated edits; (rule, file, message) is
+    # stable across them
+    return (d.rule, d.file or "", d.message)
+
+
+def _load_baseline(path):
+    with open(path) as f:
+        data = json.load(f)
+    return {(rec["rule"], rec.get("file") or "", rec["message"])
+            for rec in data.get("findings", [])}
+
+
+def _write_baseline(path, diags: List[Diagnostic]):
+    recs = [{"rule": d.rule, "file": d.file, "message": d.message}
+            for d in diags]
+    with open(path, "w") as f:
+        json.dump({"format": 1, "findings": recs}, f, indent=2,
+                  sort_keys=True)
+        f.write("\n")
 
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # importing the passes registers their rules
-    from . import graph_check, retrace, trace_lint
+    from . import concurrency, graph_check, retrace, trace_lint
 
     if args.list_rules:
         print(_list_rules())
@@ -83,13 +153,34 @@ def main(argv=None) -> int:
 
     paths = list(args.paths)
     run_retrace = args.retrace
+    report_files = None
     if args.self_check:
         import os
         paths.extend(p for p in SELF_PATHS if os.path.exists(p))
         run_retrace = True
+    if args.changed:
+        import os
+        changed = _git_changed_files()
+        # inside this repo, scope to what --self lints (tests are not
+        # gated); in a foreign tree every changed .py file counts
+        if not paths and any(os.path.exists(p) for p in SELF_PATHS):
+            changed = [f for f in changed
+                       if any(f == p
+                              or f.startswith(p.rstrip("/") + "/")
+                              for p in SELF_PATHS)]
+        paths.extend(changed)
+        # the order graph needs the WHOLE tree to catch a cycle whose
+        # other half lives in an unchanged file; reporting stays scoped
+        report_files = set(changed)
 
     if paths:
         diags.extend(trace_lint.lint_paths(paths, ignore=ignore))
+        conc_paths = paths
+        if report_files is not None:
+            import os
+            conc_paths = [p for p in SELF_PATHS if os.path.exists(p)]
+        diags.extend(concurrency.audit_lock_order(
+            conc_paths, ignore=ignore, report_files=report_files))
 
     for gpath in args.graph:
         from ..symbol import load as sym_load
@@ -110,9 +201,25 @@ def main(argv=None) -> int:
         diags.extend(d for d in retrace.audit_retrace()
                      if d.rule not in ignore)
 
-    if not paths and not args.graph and not run_retrace:
+    if not paths and not args.graph and not run_retrace \
+            and not args.changed:
         _build_parser().print_usage()
         return 2
+
+    if args.baseline:
+        try:
+            known = _load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print("mxlint: cannot read baseline %s: %s"
+                  % (args.baseline, e), file=sys.stderr)
+            return 2
+        diags = [d for d in diags if _baseline_key(d) not in known]
+
+    if args.write_baseline:
+        _write_baseline(args.write_baseline, diags)
+        print("mxlint: wrote %d finding(s) to baseline %s"
+              % (len(diags), args.write_baseline))
+        return 0
 
     print(render_json(diags) if args.as_json else render_human(diags))
     failing = [d for d in diags

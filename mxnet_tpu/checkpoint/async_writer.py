@@ -27,6 +27,7 @@ import time
 
 import numpy as np
 
+from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 
@@ -75,7 +76,7 @@ class AsyncWriter:
     def __init__(self):
         self._thread = None
         self._error = None
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock(name="checkpoint.async_writer")
 
     # -- error propagation --------------------------------------------
     def check(self):

@@ -20,7 +20,12 @@ thread.  ``DeviceFeed`` moves that transfer off the hot path:
 - error/shutdown semantics follow the checkpoint/bulk precedent:
   producer exceptions re-raise at the consumer's next ``next()``,
   ``close()`` joins the thread, ``reset()`` restarts cleanly -- no
-  leaked daemon state between epochs.
+  leaked daemon state between epochs.  The producer holds the feed
+  only through a *weak* reference while idle/blocked, and a
+  ``weakref.finalize`` stops it when the consumer abandons iteration
+  mid-epoch without ``close()`` (GC), so a full staging buffer can
+  never strand the thread (ISSUE 5 satellite; leak test in
+  tests/test_dataio.py).
 
 Telemetry (``feed.*`` instruments, docs/observability.md): producer
 busy time, consumer wait, bytes staged, and the per-epoch overlap
@@ -33,12 +38,14 @@ import os
 import queue
 import threading
 import time
+import weakref
 
 import numpy as np
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..context import Context
@@ -144,12 +151,15 @@ class DeviceFeed:
         self._thread = None
         self._stop = None
         self._error = None
+        self._finalizer = None
         # producer busy / consumer wait / bytes staged / batches --
         # always maintained (a few float adds per BATCH, not per op) so
         # overlap_frac() works with telemetry off; mirrored into the
-        # feed.* instruments when telemetry is on
+        # feed.* instruments when telemetry is on.  Producer and
+        # consumer both write, so every access holds the stats lock.
         self._stats = {"producer_busy": 0.0, "consumer_wait": 0.0,
                        "bytes_staged": 0, "batches": 0}
+        self._stats_lock = _sync.Lock(name="feed.stats")
         self._start()
 
     # -- placement -----------------------------------------------------
@@ -194,86 +204,101 @@ class DeviceFeed:
         return getattr(self.transform, "dtype", None)
 
     # -- source normalization ------------------------------------------
-    def _host_batches(self):
-        """Generator of ``(tuple_of_host_arrays, pad)`` from whatever
-        the source is."""
+    def _make_next_batch(self):
+        """One-batch step ``() -> (tuple_of_host_arrays, pad)`` closing
+        over the *source* only -- never the feed.  The producer derefs
+        the feed weakly per batch, so a consumer that abandons
+        iteration (GC without close()) releases the thread instead of
+        being kept alive by it."""
         src = self._source
         if hasattr(src, "next_np"):          # ImageIter zero-copy path
-            while True:
-                try:
-                    data, labels, pad = src.next_np()
-                except StopIteration:
-                    return
-                yield (data, labels), pad
+            def next_batch():
+                data, labels, pad = src.next_np()
+                return (data, labels), pad
         elif hasattr(src, "next") and hasattr(src, "reset"):  # DataIter
-            while True:
-                try:
-                    batch = src.next()
-                except StopIteration:
-                    return
+            def next_batch():
+                batch = src.next()
                 arrays = tuple(batch.data) + tuple(batch.label or ())
-                yield arrays, getattr(batch, "pad", 0) or 0
+                return arrays, getattr(batch, "pad", 0) or 0
         else:
-            for item in self._src_iter:
+            it = self._src_iter
+
+            def next_batch():
+                item = next(it)
                 if isinstance(item, (tuple, list)):
-                    yield tuple(item), 0
-                else:
-                    yield (item,), 0
+                    return tuple(item), 0
+                return (item,), 0
+        return next_batch
 
     # -- producer ------------------------------------------------------
+    @staticmethod
+    def _producer_put(q, stop, item):
+        """Blocking put that stays responsive to close()/finalize."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _start(self):
-        self._queue = queue.Queue(self._depth)
-        self._stop = threading.Event()
+        self._queue = q = queue.Queue(self._depth)
+        self._stop = stop = _sync.Event(name="feed.stop")
         self._error = None
         # a plain iterable is consumed through one iterator per epoch
         self._src_iter = iter(self._source) \
             if not (hasattr(self._source, "next_np")
                     or hasattr(self._source, "next")) else None
+        next_batch = self._make_next_batch()
+        wself = weakref.ref(self)
 
         def run():
             out = _END
             try:
-                batches = self._host_batches()
-                while not self._stop.is_set():
+                while not stop.is_set():
                     # busy window = host batch production (decode/
                     # batchify) + async transfer issue; the blocking
                     # put below is backpressure, not work, and stays
                     # outside it
                     t0 = time.perf_counter()
                     try:
-                        arrays, pad = next(batches)
+                        arrays, pad = next_batch()
                     except StopIteration:
                         break
+                    feed = wself()
+                    if feed is None:         # consumer GC'd mid-epoch
+                        return
                     staged, nbytes = [], 0
                     for a in arrays:
-                        d, nb = self._stage(a)
+                        d, nb = feed._stage(a)
                         staged.append(d)
                         nbytes += nb
                     busy = time.perf_counter() - t0
-                    self._stats["producer_busy"] += busy
-                    self._stats["bytes_staged"] += nbytes
-                    self._stats["batches"] += 1
+                    with feed._stats_lock:
+                        feed._stats["producer_busy"] += busy
+                        feed._stats["bytes_staged"] += nbytes
+                        feed._stats["batches"] += 1
+                    # drop the strong ref BEFORE the blocking put: while
+                    # parked on a full buffer this thread must not be
+                    # what keeps the feed alive
+                    feed = None
                     if _telemetry._ENABLED:
                         _telemetry.hooks.feed_produce(busy, nbytes)
-                    if not self._put((tuple(staged), pad)):
+                    if not DeviceFeed._producer_put(
+                            q, stop, (tuple(staged), pad)):
                         return
             except BaseException as e:  # re-raised at consumer next()
                 out = e
-            self._put(out)
+            DeviceFeed._producer_put(q, stop, out)
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name="mxnet_tpu.DeviceFeed")
+        # GC of an abandoned feed wakes the producer out of a full
+        # buffer; close() detaches this and does the full join
+        self._finalizer = weakref.finalize(self, _release_producer,
+                                           q, stop)
         self._thread.start()
-
-    def _put(self, item):
-        """Blocking put that stays responsive to ``close()``."""
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
 
     # -- consumer ------------------------------------------------------
     def __iter__(self):
@@ -288,7 +313,8 @@ class DeviceFeed:
         t0 = time.perf_counter()
         item = self._queue.get()
         wait = time.perf_counter() - t0
-        self._stats["consumer_wait"] += wait
+        with self._stats_lock:
+            self._stats["consumer_wait"] += wait
         if _telemetry._ENABLED:
             _telemetry.hooks.feed_wait(wait)
         if item is _END:
@@ -324,15 +350,18 @@ class DeviceFeed:
     # -- stats ---------------------------------------------------------
     def stats(self):
         """Copy of the feed counters (seconds / bytes / batches)."""
-        return dict(self._stats)
+        with self._stats_lock:
+            return dict(self._stats)
 
     def overlap_frac(self):
         """Share of producer (decode+transfer) time hidden behind
         consumer compute: ``1 - consumer_wait / producer_busy``."""
-        busy = self._stats["producer_busy"]
+        with self._stats_lock:
+            busy = self._stats["producer_busy"]
+            wait = self._stats["consumer_wait"]
         if busy <= 0:
             return 0.0
-        return max(0.0, 1.0 - self._stats["consumer_wait"] / busy)
+        return max(0.0, 1.0 - wait / busy)
 
     # -- lifecycle -----------------------------------------------------
     def reset(self):
@@ -352,6 +381,8 @@ class DeviceFeed:
 
     def close(self):
         """Join the producer thread; idempotent, safe mid-epoch."""
+        if self._finalizer is not None:
+            self._finalizer.detach()
         if self._stop is not None:
             self._stop.set()
         # drain so a producer blocked on put() wakes promptly
@@ -371,8 +402,15 @@ class DeviceFeed:
     def __exit__(self, *exc):
         self.close()
 
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
+
+def _release_producer(q, stop):
+    """``weakref.finalize`` callback shared by the staged-feed classes:
+    stop the producer of an iterator its consumer abandoned, and drain
+    the buffer so a put() parked on a full queue wakes immediately.
+    Deliberately holds NO reference to the feed -- that is the point."""
+    stop.set()
+    try:
+        while True:
+            q.get_nowait()
+    except queue.Empty:
+        pass

@@ -147,6 +147,23 @@ _VARS = [
            "transform.  '0' pre-casts host-side to the transform's "
            "target dtype before staging (A/B numerics debugging).  "
            "Per-feed override: DeviceFeed(compact=...)."),
+    EnvVar("MXNET_TPU_TSAN", bool, False,
+           "'1' arms the concurrency sanitizer (mxnet_tpu.sync): every "
+           "Lock/RLock/Condition/Event the framework creates records "
+           "per-thread acquisition stacks, maintains the lock-order "
+           "graph (seeded from the static analysis pass) and raises "
+           "LockOrderError on an A/B-B/A inversion, and time-bounds "
+           "every untimed blocking acquisition/wait with a deadlock "
+           "watchdog that dumps all thread stacks.  Off (the default), "
+           "the factories return raw threading primitives -- zero "
+           "overhead.  CI runs the threaded test files under this flag "
+           "(ci/run_all.sh tsan)."),
+    EnvVar("MXNET_TPU_TSAN_WATCHDOG_S", float, 20.0,
+           "Deadlock-watchdog budget (seconds) for untimed lock "
+           "acquisitions and Condition/Event waits under "
+           "MXNET_TPU_TSAN=1.  On expiry the sanitizer raises "
+           "DeadlockError carrying every thread's stack plus the "
+           "held-locks table (who holds what, acquired where)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

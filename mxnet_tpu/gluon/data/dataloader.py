@@ -19,6 +19,7 @@ import numpy as np
 
 import jax
 
+from ... import sync as _sync
 from ... import telemetry as _telemetry
 from ...base import MXNetError
 from ...ndarray import NDArray, array
@@ -150,15 +151,16 @@ class DataLoader:
         """Ordered thread-pool pipeline with bounded prefetch."""
         batches = list(self._batch_sampler)
         results = {}
-        results_lock = threading.Lock()
-        results_ready = threading.Condition(results_lock)
+        results_lock = _sync.Lock(name="dataloader.results")
+        results_ready = _sync.Condition(results_lock,
+                                        name="dataloader.results_ready")
         # Prefetch bound: decoded-but-unconsumed batches never exceed this,
         # so memory stays O(prefetch), not O(dataset).
         prefetch = max(self._prefetch, 1)
         work = queue.Queue()
         for i, b in enumerate(batches):
             work.put((i, b))
-        stop = threading.Event()
+        stop = _sync.Event(name="dataloader.stop")
         next_wanted = [0]
 
         def worker():

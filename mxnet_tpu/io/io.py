@@ -11,10 +11,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from collections import namedtuple
 
 import numpy as np
 
+from .. import sync as _sync
 from ..base import MXNetError
 from ..ndarray import NDArray, array
 
@@ -165,7 +167,13 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference: ``PrefetchingIter`` /
-    dmlc ThreadedIter double-buffering)."""
+    dmlc ThreadedIter double-buffering).
+
+    The producer closes over the *inner* iterator only and every put is
+    stop-responsive, so a consumer that abandons iteration mid-epoch
+    (GC without ``close()``) can never strand the thread parked on a
+    full buffer -- the ``weakref.finalize`` stops it (the same
+    discipline as ``mxnet_tpu.dataio.DeviceFeed``)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -178,35 +186,61 @@ class PrefetchingIter(DataIter):
         self._depth = prefetch_depth
         self._queue = None
         self._thread = None
+        self._finalizer = None
         self._start()
 
     def _start(self):
-        self._queue = queue.Queue(self._depth)
-        self._stop = threading.Event()
+        self._queue = q = queue.Queue(self._depth)
+        self._stop = stop = _sync.Event(name="io.prefetch.stop")
+        inner = self.iter
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def run():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
-                    batch = self.iter.next()
+                    batch = inner.next()
                 except StopIteration:
-                    self._queue.put(None)
+                    put(None)
                     return
-                except Exception as e:
-                    self._queue.put(e)
+                except Exception as e:       # re-raised at next()
+                    put(e)
                     return
-                self._queue.put(batch)
+                if not put(batch):
+                    return
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mxnet_tpu.PrefetchingIter")
+        from ..dataio.feed import _release_producer
+        self._finalizer = weakref.finalize(self, _release_producer,
+                                           q, stop)
         self._thread.start()
 
+    def close(self):
+        """Stop and join the producer; idempotent, safe mid-epoch."""
+        if self._finalizer is not None:
+            self._finalizer.detach()
+        if self._stop is not None:
+            self._stop.set()
+        if self._queue is not None:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+        th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=5)
+
     def reset(self):
-        self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        self.close()
         self.iter.reset()
         self._start()
 

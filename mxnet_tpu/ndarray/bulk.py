@@ -24,6 +24,8 @@ import threading
 import jax
 import numpy as np
 
+from .. import sync as _sync
+
 __all__ = ["LazyData", "enabled", "enqueue", "flush", "materialize",
            "set_bulk_size"]
 
@@ -127,7 +129,7 @@ class _Region:
 # region; replay respects the slot-level data dependencies, and eager
 # ops are pure, so interleaving only affects the structural key.
 
-_LOCK = threading.RLock()
+_LOCK = _sync.RLock(name="bulk.region")
 
 _entries = []          # [(fnc, key_tag, treedef, markers, out_slots, out_treedef)]
 _leaf_vals = []        # concrete leaf inputs for the current epoch

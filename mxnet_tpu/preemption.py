@@ -28,6 +28,7 @@ import signal
 import threading
 import warnings
 
+from . import sync as _sync
 from . import telemetry as _telemetry
 from .base import MXNetError
 from .checkpoint import core as _ckpt
@@ -70,7 +71,7 @@ class PreemptionHandler:
         self._saving = False
         # RLock: the SIGTERM handler runs on the same thread and may
         # interrupt an explicit save_now() call mid-save
-        self._lock = threading.RLock()
+        self._lock = _sync.RLock(name="preemption.handler")
         # a previous incarnation killed between write_fn(tmp) and
         # os.replace strands its temp forever; clean house on arm
         _ckpt.sweep_stale_tmps(os.path.dirname(self.prefix) or ".",
@@ -125,7 +126,11 @@ class PreemptionHandler:
                 return
             self._saving = True    # re-entrancy: signal during save
             try:
-                nd.waitall()       # drain the async queue first
+                # the drain deliberately runs under the handler lock:
+                # the lock is re-entered only by the SIGTERM handler on
+                # THIS thread (RLock), never contended across threads,
+                # and the saved state must not advance past the drain
+                nd.waitall()  # mxlint: disable=blocking-under-lock
                 if self._fallback_saved and not provisional:
                     # re-arm the meta-last atomicity gate before
                     # overwriting a provisional checkpoint: otherwise a

@@ -44,6 +44,9 @@ def _detect():
         # enable state, so feature_list() answers "is this run
         # instrumented" rather than "was it compiled in"
         "TELEMETRY": _telemetry_enabled(),
+        # concurrency sanitizer (mx.sync): LIVE arm state, same
+        # contract as the TELEMETRY row
+        "TSAN": _tsan_enabled(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -51,6 +54,11 @@ def _detect():
 def _telemetry_enabled():
     from . import telemetry
     return telemetry.enabled()
+
+
+def _tsan_enabled():
+    from . import sync
+    return sync.tsan_enabled()
 
 
 def _try_import(mod):

@@ -16,8 +16,9 @@ skip every call below when it is off.
 from __future__ import annotations
 
 import bisect
-import threading
 import time
+
+from .. import sync as _sync
 
 __all__ = ["Counter", "Gauge", "Timer", "Event", "Registry"]
 
@@ -35,7 +36,9 @@ class Instrument:
     def __init__(self, name, registry=None):
         self.name = name
         self._registry = registry
-        self._lock = threading.Lock()
+        # one role identity for every instrument's lock: the order
+        # graph (docs/concurrency.md) reasons about roles, not instances
+        self._lock = _sync.Lock(name="telemetry.instrument")
 
     def _stream(self, record_kind, **fields):
         reg = self._registry
@@ -244,7 +247,7 @@ class Registry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock(name="telemetry.registry")
         self._instruments = {}
         self._sinks = []
 

@@ -62,6 +62,14 @@ checkpoint.restore_time timer  wall time verifying+loading a restore
 checkpoint.async_wait  timer   time a save spent draining the previous
                                in-flight async write (rivals step time
                                => saving faster than the I/O)
+sync.contention_wait   timer   time spent blocked acquiring a
+                               contended lock (MXNET_TPU_TSAN=1 only;
+                               labeled by lock role name)
+sync.hold_time         timer   lock hold duration (TSAN only)
+sync.watchdog_fires    counter deadlock-watchdog expiries (TSAN only)
+sync.inversions        counter lock-order inversions observed (TSAN
+                               report-only mode records instead of
+                               raising)
 =====================  ======  =========================================
 """
 from __future__ import annotations
@@ -71,6 +79,7 @@ __all__ = [
     "samples_per_sec", "kv_op", "dataloader_wait", "feed_produce",
     "feed_wait", "feed_overlap", "amp_overflow", "amp_rescale",
     "checkpoint", "checkpoint_wait",
+    "sync_contention", "sync_hold", "sync_watchdog", "sync_inversion",
 ]
 
 
@@ -188,3 +197,24 @@ def checkpoint_wait(seconds, step=None):
     reg = _registry()
     reg.timer("checkpoint.async_wait").observe(
         seconds, **({} if step is None else {"step": step}))
+
+
+def sync_contention(lock_name, seconds):
+    _registry().timer("sync.contention_wait").observe(seconds,
+                                                      lock=lock_name)
+
+
+def sync_hold(lock_name, seconds):
+    _registry().timer("sync.hold_time").observe(seconds, lock=lock_name)
+
+
+def sync_watchdog(lock_name):
+    reg = _registry()
+    reg.counter("sync.watchdog_fires").inc()
+    reg.event("sync.watchdog").emit(lock=lock_name)
+
+
+def sync_inversion(outer, inner):
+    reg = _registry()
+    reg.counter("sync.inversions").inc()
+    reg.event("sync.inversion").emit(outer=outer, inner=inner)

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import json
 import re
-import threading
+
+from .. import sync as _sync
 
 __all__ = ["JsonlSink", "prom_text", "summary_table"]
 
@@ -30,7 +31,7 @@ class JsonlSink:
 
     def __init__(self, path):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock(name="telemetry.jsonl_sink")
         self._f = open(path, "a")
 
     def write(self, record):

@@ -147,6 +147,283 @@ def test_lint_paths_on_repo_is_clean():
 
 
 # ----------------------------------------------------------------------
+# concurrency pass (ISSUE 5): one positive and one negative fixture
+# per rule
+# ----------------------------------------------------------------------
+
+def test_unguarded_shared_write_fires_and_guarded_twin_silent():
+    bad = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "    def _run(self):\n"
+        "        self.count += 1\n"           # thread side, no lock
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._run, daemon=True)\n"
+        "        t.start()\n"
+        "        self.count = 5\n"            # main side
+    )
+    assert _rules_of(_lint(bad)) == ["unguarded-shared-write"]
+    good = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"            # __init__ is construction
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._run, daemon=True)\n"
+        "        t.start()\n"
+        "        with self._lock:\n"
+        "            self.count = 5\n"
+    )
+    assert _lint(good) == []
+
+
+def test_unguarded_shared_write_sees_container_mutation():
+    bad = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self.stats = {'n': 0}\n"
+        "    def _run(self):\n"
+        "        self.stats['n'] += 1\n"
+        "    def go(self):\n"
+        "        threading.Thread(target=self._run, daemon=True).start()\n"
+        "        self.stats['n'] = 9\n"
+    )
+    assert _rules_of(_lint(bad)) == ["unguarded-shared-write"]
+
+
+def test_blocking_under_lock_fires_and_clean_twin_silent():
+    bad = (
+        "import queue, threading, time\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def drain(self):\n"
+        "        with self._lock:\n"
+        "            item = self._q.get()\n"      # blocking under lock
+        "            time.sleep(1)\n"             # and this
+        "            f = open('x')\n"             # and this
+        "        return item, f\n"
+    )
+    diags = _lint(bad)
+    assert _rules_of(diags) == ["blocking-under-lock"]
+    assert len(diags) == 3
+    good = (
+        "import queue, threading, time\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "    def drain(self):\n"
+        "        item = self._q.get()\n"          # outside the lock
+        "        with self._lock:\n"
+        "            self.last = item\n"
+        "        return item\n"
+    )
+    assert _lint(good) == []
+
+
+def test_blocking_under_lock_allows_condition_idiom():
+    ok = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(0.1)\n"        # the condition protocol
+    )
+    assert _lint(ok) == []
+    # waiting on a DIFFERENT primitive while holding is still flagged
+    bad = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._ev = threading.Event()\n"
+        "    def take(self):\n"
+        "        with self._lock:\n"
+        "            self._ev.wait()\n"
+    )
+    assert _rules_of(_lint(bad)) == ["blocking-under-lock"]
+
+
+def test_bare_thread_fires_and_daemonized_twins_silent():
+    bad = ("import threading\n"
+           "def go(fn):\n"
+           "    t = threading.Thread(target=fn)\n"
+           "    t.start()\n")
+    assert _rules_of(_lint(bad)) == ["bare-thread"]
+    good_kw = ("import threading\n"
+               "def go(fn):\n"
+               "    t = threading.Thread(target=fn, daemon=True)\n"
+               "    t.start()\n")
+    assert _lint(good_kw) == []
+    good_attr = ("import threading\n"
+                 "def go(fn):\n"
+                 "    t = threading.Thread(target=fn)\n"
+                 "    t.daemon = True\n"
+                 "    t.start()\n")
+    assert _lint(good_attr) == []
+
+
+def test_sleep_poll_fires_and_event_wait_twin_silent():
+    bad = ("import time\n"
+           "def spin(ready):\n"
+           "    while not ready():\n"
+           "        time.sleep(0.1)\n")
+    assert _rules_of(_lint(bad)) == ["sleep-poll"]
+    good = ("def spin(ev):\n"
+            "    while not ev.is_set():\n"
+            "        ev.wait(0.1)\n")
+    assert _lint(good) == []
+    # a one-shot backoff sleep outside a loop is not polling
+    single = ("import time\n"
+              "def backoff():\n"
+              "    time.sleep(5)\n")
+    assert _lint(single) == []
+
+
+_INVERT_A = (
+    "import threading\n"
+    "a = threading.Lock()\n"
+    "b = threading.Lock()\n"
+    "def fwd():\n"
+    "    with a:\n"
+    "        with b:\n"
+    "            pass\n"
+)
+_INVERT_B = (
+    "from probe_a import a, b\n"
+    "import threading\n"
+    "a = threading.Lock()\n"
+    "b = threading.Lock()\n"
+    "def rev():\n"
+    "    with b:\n"
+    "        with a:\n"
+    "            pass\n"
+)
+
+
+def test_lock_order_inversion_cycle_across_files(tmp_path):
+    """The cross-file half: opposite nestings of the same named locks
+    in two modules close a cycle."""
+    conc = __import__("mxnet_tpu.analysis.concurrency",
+                      fromlist=["audit_lock_order"])
+    # named sync locks share identity across files
+    (tmp_path / "probe_a.py").write_text(
+        "import sync\n"
+        "a = sync.Lock(name='L.a')\n"
+        "b = sync.Lock(name='L.b')\n"
+        "def fwd():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n")
+    (tmp_path / "probe_b.py").write_text(
+        "import sync\n"
+        "a = sync.Lock(name='L.a')\n"
+        "b = sync.Lock(name='L.b')\n"
+        "def rev():\n"
+        "    with b:\n"
+        "        with a:\n"
+        "            pass\n")
+    diags = conc.audit_lock_order([str(tmp_path)])
+    assert diags and all(d.rule == "lock-order-inversion" for d in diags)
+    assert any("L.a" in d.message and "L.b" in d.message for d in diags)
+    # consistent order across both files: clean
+    (tmp_path / "probe_b.py").write_text(
+        "import sync\n"
+        "a = sync.Lock(name='L.a')\n"
+        "b = sync.Lock(name='L.b')\n"
+        "def fwd2():\n"
+        "    with a:\n"
+        "        with b:\n"
+        "            pass\n")
+    assert conc.audit_lock_order([str(tmp_path)]) == []
+
+
+def test_lock_order_inversion_single_file_and_suppression(tmp_path):
+    p = tmp_path / "single.py"
+    p.write_text(_INVERT_A + _INVERT_B.replace("from probe_a import a, b\n",
+                                               "")
+                 .replace("import threading\n", "", 1)
+                 .replace("a = threading.Lock()\n", "", 1)
+                 .replace("b = threading.Lock()\n", "", 1))
+    conc = __import__("mxnet_tpu.analysis.concurrency",
+                      fromlist=["audit_lock_order"])
+    diags = conc.audit_lock_order([str(p)])
+    assert diags and {d.rule for d in diags} == {"lock-order-inversion"}
+    # suppression on the closing-edge line silences that site
+    src = p.read_text().replace(
+        "        with a:\n",
+        "        with a:  # mxlint: disable=lock-order-inversion\n")
+    p.write_text(src)
+    remaining = conc.audit_lock_order([str(p)])
+    assert all("# mxlint" not in line for line in
+               [src.splitlines()[d.line - 1] for d in remaining])
+
+
+def test_static_order_edges_cover_package():
+    """The bridge the runtime sanitizer seeds from: the package-wide
+    edge set computes without error and contains only role names."""
+    edges = an.static_order_edges(["mxnet_tpu"])
+    assert isinstance(edges, set)
+    for a, b in edges:
+        assert isinstance(a, str) and isinstance(b, str)
+
+
+# ----------------------------------------------------------------------
+# --changed / --baseline (incremental lint)
+# ----------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-c", "user.email=ci@test",
+                    "-c", "user.name=ci"] + list(args),
+                   cwd=cwd, check=True, capture_output=True)
+
+
+def test_cli_changed_lints_only_diffed_files(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    _git(repo, "init", "-q")
+    clean = repo / "clean.py"
+    clean.write_text("def f(a=[]):\n    return a\n")   # pre-existing bug
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "seed")
+    monkeypatch.chdir(repo)
+    # clean tree: --changed falls back to the last commit's files
+    assert an.main(["--changed"]) == 1
+    # now the committed bug is baselined away
+    assert an.main(["--changed", "--write-baseline", "base.json"]) == 0
+    assert an.main(["--changed", "--baseline", "base.json"]) == 0
+    # a NEW finding in a newly-changed file still fails
+    bad = repo / "bad.py"
+    bad.write_text("try:\n    pass\nexcept:\n    pass\n")
+    rc = an.main(["--changed", "--baseline", "base.json"])
+    assert rc == 1
+
+
+def test_cli_baseline_suppresses_known_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(a=[]):\n    return a\n")
+    base = tmp_path / "base.json"
+    assert an.main([str(bad), "--write-baseline", str(base)]) == 0
+    assert an.main([str(bad), "--baseline", str(base)]) == 0
+    # an unrelated new finding is NOT covered by the baseline
+    bad.write_text("def f(a=[]):\n    return a\n"
+                   "try:\n    pass\nexcept:\n    pass\n")
+    assert an.main([str(bad), "--baseline", str(base)]) == 1
+
+
+# ----------------------------------------------------------------------
 # graph checker
 # ----------------------------------------------------------------------
 

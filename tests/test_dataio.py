@@ -326,3 +326,52 @@ def test_engine_bulk_scope_executes_and_restores():
         c = (a + 1) * 2
     assert (bulk._MAX_PENDING, bulk.enabled()) == before
     assert c.asnumpy()[0, 0] == 4.0
+
+
+# -- satellite: abandoned consumers cannot strand a producer -----------
+
+def test_abandoned_feed_releases_producer_thread():
+    """A consumer that walks away mid-epoch WITHOUT close() (plain GC)
+    must not leave the producer parked forever on a full buffer: the
+    producer holds the feed only weakly while blocked, and the
+    weakref finalizer stops it."""
+    import gc
+
+    src = [np.ones((2, 2), np.float32) for _ in range(64)]
+    feed = DeviceFeed(src, ctx=mx.cpu(), depth=1)
+    next(feed)                       # producer running, buffer fills
+    th = feed._thread
+    assert th.is_alive()
+    del feed                         # abandon: no close()
+    gc.collect()
+    th.join(timeout=10)
+    assert not th.is_alive(), \
+        "producer thread leaked after its consumer was GC'd"
+
+
+def test_abandoned_prefetching_iter_releases_producer_thread():
+    import gc
+
+    from mxnet_tpu.io.io import NDArrayIter, PrefetchingIter
+
+    inner = NDArrayIter(np.ones((64, 2), np.float32), batch_size=2)
+    pf = PrefetchingIter(inner, prefetch_depth=1)
+    pf.next()
+    th = pf._thread
+    assert th.is_alive()
+    del pf
+    gc.collect()
+    th.join(timeout=10)
+    assert not th.is_alive(), \
+        "PrefetchingIter producer leaked after consumer GC"
+
+
+def test_feed_close_detaches_finalizer_and_joins():
+    src = [np.ones((2, 2), np.float32) for _ in range(8)]
+    feed = DeviceFeed(src, ctx=mx.cpu(), depth=1)
+    next(feed)
+    fin = feed._finalizer
+    feed.close()
+    assert not fin.alive             # close() detached it
+    assert feed._thread is None
+    feed.close()                     # idempotent

@@ -1,0 +1,296 @@
+"""mxnet_tpu.sync: the instrumented synchronization layer (ISSUE 5
+runtime half) -- zero-overhead pass-through when off, lock-order
+sanitizer + deadlock watchdog when armed."""
+import os
+import threading
+import time
+
+import pytest
+
+from mxnet_tpu import sync
+
+_TSAN_ENV = os.environ.get("MXNET_TPU_TSAN", "0") != "0"
+
+
+@pytest.fixture(autouse=True)
+def _restore_sync_state():
+    """Each test leaves the sanitizer exactly as it found it (the CI
+    tsan stage runs this file with the env flag armed; tier-1 runs it
+    unarmed)."""
+    was_on = sync.tsan_enabled()
+    yield
+    if was_on:
+        sync.enable(seed_static=False)
+    else:
+        sync.disable()
+    sync.configure(raise_on_inversion=True,
+                   watchdog_s=sync._watchdog_default())
+    sync.reset_state()
+
+
+# ----------------------------------------------------------------------
+# off mode: raw primitives, nothing to measure
+# ----------------------------------------------------------------------
+
+@pytest.mark.skipif(_TSAN_ENV, reason="suite running under TSAN")
+def test_off_mode_returns_raw_primitives():
+    """The zero-overhead contract: with the flag off the factories
+    return the *raw* threading primitives -- there is no wrapper to
+    pay for on acquire/release."""
+    assert type(sync.Lock()) is type(threading.Lock())
+    assert type(sync.RLock()) is type(threading.RLock())
+    assert isinstance(sync.Condition(), threading.Condition)
+    assert isinstance(sync.Event(), threading.Event)
+    # a sanitized lock shared into a raw Condition still works
+    lk = sync.Lock(name="probe")
+    cond = sync.Condition(lk)
+    with cond:
+        cond.notify_all()
+
+
+def test_enable_switches_factories():
+    sync.enable(seed_static=False)
+    try:
+        assert isinstance(sync.Lock(name="a"), sync._TsanLock)
+        assert isinstance(sync.RLock(name="b"), sync._TsanRLock)
+        assert isinstance(sync.Condition(name="c"), sync._TsanCondition)
+        assert isinstance(sync.Event(name="d"), sync._TsanEvent)
+    finally:
+        sync.disable()
+    if not _TSAN_ENV:
+        assert type(sync.Lock()) is type(threading.Lock())
+
+
+def test_wrappers_turn_inert_after_disable():
+    sync.enable(seed_static=False)
+    a = sync.Lock(name="inert.a")
+    b = sync.Lock(name="inert.b")
+    sync.disable()
+    # order bookkeeping is off: opposite nestings never raise
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert "inert.a" not in sync.order_graph()
+
+
+# ----------------------------------------------------------------------
+# lock-order sanitizer
+# ----------------------------------------------------------------------
+
+def test_lock_order_inversion_raises():
+    """The injected A/B--B/A inversion: observed on ONE thread is
+    enough -- the graph, not a lucky schedule, is the oracle."""
+    sync.enable(watchdog_s=30, seed_static=False)
+    a = sync.Lock(name="inv.a")
+    b = sync.Lock(name="inv.b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(sync.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    msg = str(ei.value)
+    assert "inv.a" in msg and "inv.b" in msg
+    assert "acquired at" in msg          # both stacks are in the report
+    # the failed acquire must NOT leave the lock held
+    assert a._inner.acquire(timeout=1)
+    a._inner.release()
+
+
+def test_inversion_report_only_mode_records():
+    sync.enable(watchdog_s=30, seed_static=False)
+    sync.configure(raise_on_inversion=False)
+    a = sync.Lock(name="rep.a")
+    b = sync.Lock(name="rep.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                           # recorded, not raised
+            pass
+    reports = sync.recorded_reports()
+    assert len(reports) == 1
+    assert "rep.a" in reports[0] and "rep.b" in reports[0]
+
+
+def test_rlock_reentry_adds_no_edges():
+    sync.enable(watchdog_s=30, seed_static=False)
+    r = sync.RLock(name="re.r")
+    other = sync.Lock(name="re.other")
+    with r:
+        with r:                           # reentry: no self edge
+            with other:
+                pass
+    graph = sync.order_graph()
+    assert graph.get("re.r") == {"re.other"}
+    # and the reverse order now trips
+    with pytest.raises(sync.LockOrderError):
+        with other:
+            with r:
+                pass
+
+
+def test_three_lock_cycle_detected():
+    """A -> B, B -> C observed; C -> A closes the cycle through the
+    transitive path, not a direct edge."""
+    sync.enable(watchdog_s=30, seed_static=False)
+    a, b, c = (sync.Lock(name="cyc.%s" % n) for n in "abc")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(sync.LockOrderError) as ei:
+        with c:
+            with a:
+                pass
+    assert "cyc.b" in str(ei.value)       # the path names the middleman
+
+
+def test_static_seed_is_best_effort_and_idempotent():
+    sync.enable(seed_static=True)
+    n1 = sync.seed_static_order()         # second call: already seeded
+    assert n1 == 0
+    # the graph is usable either way
+    lk = sync.Lock(name="seed.probe")
+    with lk:
+        pass
+
+
+# ----------------------------------------------------------------------
+# deadlock watchdog
+# ----------------------------------------------------------------------
+
+def test_watchdog_fires_on_crossed_lock_deadlock():
+    """The artificial deadlock: two threads, crossed locks, report-only
+    mode so the ordering check does not defuse it first.  The watchdog
+    must fire and the report must name BOTH held stacks."""
+    sync.enable(watchdog_s=1.0, seed_static=False)
+    sync.configure(raise_on_inversion=False)
+    a = sync.Lock(name="dead.a")
+    b = sync.Lock(name="dead.b")
+    barrier = threading.Barrier(2, timeout=5)
+    errs = {}
+
+    def cross(first, second, key):
+        try:
+            with first:
+                barrier.wait()            # both hold their first lock
+                with second:
+                    pass
+        except sync.DeadlockError as e:
+            errs[key] = str(e)
+
+    t1 = threading.Thread(target=cross, args=(a, b, "t1"), daemon=True)
+    t2 = threading.Thread(target=cross, args=(b, a, "t2"), daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(timeout=10)
+    t2.join(timeout=10)
+    assert errs, "no watchdog fired on a crossed-lock deadlock"
+    report = next(iter(errs.values()))
+    assert "DEADLOCK watchdog" in report
+    # both held stacks: each lock appears as held, with its acquire site
+    assert "holds 'dead.a' acquired at" in report
+    assert "holds 'dead.b' acquired at" in report
+    assert "all thread stacks" in report
+    assert "cross" in report              # the frames name the function
+
+
+def test_watchdog_respects_caller_timeouts():
+    """A caller-supplied finite timeout keeps ``acquire`` semantics:
+    return False, never raise."""
+    sync.enable(watchdog_s=1.0, seed_static=False)
+    lk = sync.Lock(name="to.lk")
+    hold = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            hold.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert hold.wait(5)
+    assert lk.acquire(timeout=0.1) is False
+    assert lk.acquire(blocking=False) is False
+    release.set()
+    t.join(timeout=5)
+
+
+def test_event_untimed_wait_watchdogged():
+    sync.enable(watchdog_s=0.3, seed_static=False)
+    ev = sync.Event(name="ev.never")
+    with pytest.raises(sync.DeadlockError):
+        ev.wait()
+    # timed waits keep Event semantics
+    assert ev.wait(0.05) is False
+    ev.set()
+    assert ev.wait() is True
+
+
+def test_condition_wait_notify_under_tsan():
+    sync.enable(watchdog_s=5, seed_static=False)
+    cond = sync.Condition(name="cv.test")
+    items = []
+
+    def producer():
+        for i in range(3):
+            with cond:
+                items.append(i)
+                cond.notify_all()
+
+    t = threading.Thread(target=producer, daemon=True)
+    got = []
+    with cond:
+        t.start()
+        ok = cond.wait_for(lambda: len(items) == 3, timeout=5)
+        got = list(items)
+    t.join(timeout=5)
+    assert ok and got == [0, 1, 2]
+    # while waiting, the condition's lock must NOT count as held
+    # (producer acquired it without the sanitizer seeing a nesting)
+    graph = sync.order_graph()
+    assert "cv.test" not in graph.get("cv.test.lock", set())
+
+
+def test_condition_untimed_wait_watchdogged():
+    sync.enable(watchdog_s=0.3, seed_static=False)
+    cond = sync.Condition(name="cv.stuck")
+    with pytest.raises(sync.DeadlockError):
+        with cond:
+            cond.wait()                   # nobody will ever notify
+
+
+# ----------------------------------------------------------------------
+# telemetry integration
+# ----------------------------------------------------------------------
+
+def test_sync_telemetry_counts_watchdog_and_inversions():
+    from mxnet_tpu import telemetry
+    telemetry.reset("sync.")
+    telemetry.enable()
+    try:
+        sync.enable(watchdog_s=0.2, seed_static=False)
+        sync.configure(raise_on_inversion=False)
+        a = sync.Lock(name="tel.a")
+        b = sync.Lock(name="tel.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert telemetry.counter("sync.inversions").value >= 1
+        ev = sync.Event(name="tel.ev")
+        with pytest.raises(sync.DeadlockError):
+            ev.wait()
+        assert telemetry.counter("sync.watchdog_fires").value >= 1
+    finally:
+        telemetry.disable()

@@ -491,3 +491,56 @@ def test_env_vars_registered():
     assert "MXNET_TPU_TELEMETRY" in desc
     assert "MXNET_TPU_TELEMETRY_JSONL" in desc
     assert mx.env.get("MXNET_TPU_TELEMETRY") in (False, True)
+
+
+def test_instrument_increments_atomic_under_hammer():
+    """ISSUE 5 satellite: N threads x M increments must land exactly
+    N*M on every instrument kind -- the registry/instrument locks make
+    the += read-modify-write atomic."""
+    import threading
+
+    from mxnet_tpu.telemetry import Registry
+
+    reg = Registry()
+    c = reg.counter("hammer.count")
+    t = reg.timer("hammer.time")
+    e = reg.event("hammer.event")
+    N, M = 8, 2500
+
+    def pound():
+        for _ in range(M):
+            c.inc()
+            t.observe(1e-6)
+            e.emit(k=1)
+
+    threads = [threading.Thread(target=pound, daemon=True)
+               for _ in range(N)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert c.value == N * M
+    assert t.count == N * M
+    assert e.count == N * M
+
+
+def test_registry_get_or_create_race_returns_one_instance():
+    import threading
+
+    from mxnet_tpu.telemetry import Registry
+
+    reg = Registry()
+    out = []
+    barrier = threading.Barrier(8)
+
+    def grab():
+        barrier.wait()
+        out.append(reg.counter("race.one"))
+
+    threads = [threading.Thread(target=grab, daemon=True)
+               for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert len({id(o) for o in out}) == 1

@@ -74,8 +74,10 @@ def _kill_tree(procs):
             q.terminate()
     deadline = time.time() + 10
     for q in procs:
-        while q.poll() is None and time.time() < deadline:
-            time.sleep(0.1)
+        try:
+            q.wait(timeout=max(0.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            pass
         if q.poll() is None:
             try:
                 os.killpg(q.pid, signal.SIGKILL)
@@ -102,7 +104,10 @@ def _wait_all(procs):
                 if rc != 0:
                     _kill_tree(procs)
                     return rc
-            time.sleep(0.1)
+            # fail-FAST over N children needs a poll round-robin: a
+            # blocking wait on any single child would hide a sibling's
+            # death behind it (os.wait reaps relay threads' pipes too)
+            time.sleep(0.1)  # mxlint: disable=sleep-poll
         return 0
     except KeyboardInterrupt:
         _kill_tree(procs)
