@@ -52,6 +52,58 @@ def _ctx():
     return mx.tpu() if mx.num_tpus() else mx.cpu()
 
 
+# CostReport artifact paths by tag, filled by the bench fns and read by
+# main()'s extra_fn so the JSONL line carries the artifact it sits
+# next to (ISSUE 6: regression-attributable headline numbers)
+_COST_ARTIFACTS = {}
+
+
+def _persist_cost_report(tag, step, step_time_s=None,
+                         items_per_step=None):
+    """Persist the compiled step's CostReport (per-HLO-category FLOPs/
+    bytes + roofline at the measured step time) next to the bench's
+    JSONL output.  Never raises: a failed capture costs the artifact,
+    not the benchmark."""
+    try:
+        from mxnet_tpu import profiling
+        rep = profiling.report_for(step, label=tag,
+                                   step_time_s=step_time_s,
+                                   items_per_step=items_per_step)
+        if rep is None:
+            return None
+        outdir = _os.environ.get("MXNET_TPU_PROFILING_DIR") \
+            or "bench_artifacts"
+        _os.makedirs(outdir, exist_ok=True)
+        path = _os.path.join(outdir, tag + ".cost.json")
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+        _COST_ARTIFACTS[tag] = path
+        return path
+    except Exception:
+        return None
+
+
+def _cost_extra(tag):
+    """extra_fn fields for the emitted JSONL line: artifact path plus
+    the top category + its roofline bound, so the line itself says
+    where the FLOPs went."""
+    path = _COST_ARTIFACTS.get(tag)
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            rep = json.load(f)
+        top = max(rep["categories"],
+                  key=lambda c: rep["categories"][c]["flops"])
+        extra = {"cost_report": path, "hlo_top_category": top}
+        rl = rep.get("roofline")
+        if rl and top in rl.get("categories", {}):
+            extra["top_category_bound"] = rl["categories"][top]["bound"]
+        return extra
+    except Exception:
+        return {"cost_report": path}
+
+
 def bench_env_health(h2d_mb=64, pingpong=20):
     """Environment-health probe, emitted BEFORE any other compute so
     the H2D number reflects a fresh tunnel (compute degrades later
@@ -346,6 +398,10 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
     peak = _peak_flops()
     if ca and ca.get("flops") and peak:
         mfu = round(ca["flops"] / dt / peak, 4)
+    # persist the per-HLO cost accounting of the measured single-step
+    # program next to the JSONL line (ISSUE 6 / ROADMAP item 2)
+    _persist_cost_report("resnet50_bf16", step, step_time_s=dt,
+                         items_per_step=batch_size)
     return med, mfu, [round(w, 1) for w in wins]
 
 
@@ -404,6 +460,9 @@ def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
     peak = _peak_flops()
     if ca and ca.get("flops") and peak:
         mfu = round(ca["flops"] * med / (batch_size * seq_len) / peak, 4)
+    _persist_cost_report("bert_base_seq%d_%s" % (seq_len, dtype), step,
+                         step_time_s=batch_size * seq_len / med,
+                         items_per_step=batch_size * seq_len)
     return med, mfu, [round(w, 1) for w in wins]
 
 
@@ -651,7 +710,8 @@ def main():
         extra_fn=lambda: {"mfu": rn_out.get("mfu"),
                           "min": min(rn_out.get("wins") or [0]),
                           "max": max(rn_out.get("wins") or [0]),
-                          "windows": rn_out.get("wins")})
+                          "windows": rn_out.get("wins"),
+                          **_cost_extra("resnet50_bf16")})
 
     # -- 2: headline BERT (bs=256 is the single-chip knee, r4) --------
     def _emit_bert(metric, bs, seq, dt_name, iters, windows=1,
@@ -667,7 +727,8 @@ def main():
 
         def extra():
             rec = {"mfu": out.get("mfu"), "seq_len": seq,
-                   "batch_size": bs}
+                   "batch_size": bs,
+                   **_cost_extra("bert_base_seq%d_%s" % (seq, dt_name))}
             if windows > 1:
                 rec.update({"min": min(out["wins"]),
                             "max": max(out["wins"]),

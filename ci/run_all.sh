@@ -14,6 +14,10 @@
 #   tsan -> threaded smoke train + the threaded test files under
 #           MXNET_TPU_TSAN=1 (lock-order sanitizer + deadlock watchdog
 #           armed), including the injected-deadlock fixtures
+#   profiling -> 3-step smoke train with cost accounting on; mxprof
+#                report must show non-empty step + category sections
+#                and mxprof diff of the run against itself must report
+#                zero drift (the regression-attribution contract)
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -22,7 +26,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -243,6 +247,60 @@ EOF
     JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py -q
+}
+
+run_profiling() {
+    log "profiling: smoke train with cost accounting -> mxprof gates"
+    pdir=$(mktemp -d /tmp/mxtpu_prof_ci.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_PROFILING=1 MXNET_TPU_TELEMETRY=1 \
+        MXNET_TPU_PROFILING_DIR="$pdir" python - <<'EOF'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiling
+from mxnet_tpu.parallel import TrainStep
+
+assert profiling.enabled(), "MXNET_TPU_PROFILING=1 did not arm capture"
+net = gluon.nn.Dense(4)
+net.initialize(); net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "lars",
+                   {"learning_rate": 0.1}, kvstore=None)
+step = TrainStep(net, gluon.loss.L2Loss(), tr, mesh=None)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(8, 16).astype(np.float32))
+y = mx.nd.array(rng.rand(8, 4).astype(np.float32))
+for _ in range(3):                       # 3 steps (trace-safe LARS)
+    loss = step(x, y)
+loss.asnumpy()
+path = profiling.save_reports()
+print("profiling smoke train done ->", path)
+EOF
+    # gate 1: the report must carry non-empty step + category sections
+    python -m mxnet_tpu.profiling report --dir "$pdir" --json > "$pdir/agg.json"
+    python - "$pdir/agg.json" <<'EOF'
+import json, sys
+agg = json.load(open(sys.argv[1]))
+assert agg["executables"], "no executables in cost report"
+assert agg["steps"], "no step section in cost report"
+assert any(st.get("count", 0) >= 3 for st in agg["steps"].values()), \
+    agg["steps"]
+assert sum(v["flops"] for v in agg["categories"].values()) > 0, \
+    agg["categories"]
+for rep in agg["executables"]:
+    tf = rep["totals"]["flops"]
+    s = sum(c["flops"] for c in rep["categories"].values())
+    assert abs(s - tf) < 1, (rep["label"], s, tf)
+    rl = rep.get("roofline")
+    if rl:
+        for cat, v in rl["categories"].items():
+            assert v["bound"] in ("compute", "memory"), (cat, v)
+print("profiling gate ok: %d executables, %d step labels, "
+      "%.0f total flops"
+      % (len(agg["executables"]), len(agg["steps"]),
+         sum(v["flops"] for v in agg["categories"].values())))
+EOF
+    # gate 2: a run diffed against itself must report ZERO drift
+    python -m mxnet_tpu.profiling diff "$pdir/report.json" "$pdir/report.json"
+    rm -rf "$pdir"
 }
 
 run_bench() {

@@ -123,6 +123,7 @@ from . import callback
 from . import module
 from . import module as mod
 from . import profiler
+from . import profiling
 from . import runtime
 from .distributed import distributed_init
 from . import numpy as np

@@ -45,6 +45,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
+from .. import profiling as _profiling
 from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -285,6 +286,12 @@ class DeviceFeed:
                     feed = None
                     if _telemetry._ENABLED:
                         _telemetry.hooks.feed_produce(busy, nbytes)
+                    if _profiling._ENABLED:
+                        # host->device transfer span on the step
+                        # timeline (mx.profiling)
+                        from ..profiling import timeline
+                        timeline.record("feed.stage", t0, busy,
+                                        {"bytes": nbytes})
                     if not DeviceFeed._producer_put(
                             q, stop, (tuple(staged), pad)):
                         return

@@ -164,6 +164,23 @@ _VARS = [
            "MXNET_TPU_TSAN=1.  On expiry the sanitizer raises "
            "DeadlockError carrying every thread's stack plus the "
            "held-locks table (who holds what, acquired where)."),
+    EnvVar("MXNET_TPU_PROFILING", bool, False,
+           "'1' enables compiled-step cost accounting (mx.profiling) "
+           "at import: every compiled executable (eager-jit cache, "
+           "hybridize cache, Executor, TrainStep) is captured for "
+           "lazy XLA cost/memory analysis with a per-HLO-category "
+           "breakdown, TrainStep dispatch walls feed the roofline, "
+           "and host spans land on the Chrome-trace step timeline.  "
+           "Off (the default), every hook is a single module-flag "
+           "check.  Runtime toggle: mx.profiling.enable()/disable(); "
+           "render with the mxprof CLI."),
+    EnvVar("MXNET_TPU_PROFILING_DIR", str, "",
+           "Directory for mx.profiling CostReport artifacts.  When "
+           "set (with profiling enabled), per-executable *.cost.json "
+           "files plus the combined report.json are written at "
+           "interpreter exit (and by mx.profiling.save_reports()); "
+           "'mxprof report'/'mxprof diff' consume them.  Unset: "
+           "nothing auto-persists; save_reports(dir) still works."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

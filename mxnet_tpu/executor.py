@@ -21,6 +21,7 @@ import time
 import jax
 import jax.numpy as jnp
 
+from . import profiling as _profiling
 from . import telemetry as _telemetry
 from .base import MXNetError
 from .ndarray import NDArray
@@ -187,6 +188,11 @@ class Executor:
                 _telemetry.hooks.compile_event(
                     "executor.train", seconds=time.perf_counter() - t0,
                     n_args=len(diff) + len(nondiff))
+            if _profiling._ENABLED:
+                _profiling.capture_jit(
+                    "executor.train", self._train_jit,
+                    (diff, nondiff, None),
+                    key=("executor", id(self), "train"), kind="executor")
             for name, v in aux_up.items():
                 if name in self.aux_dict:
                     self.aux_dict[name]._data = v
@@ -204,6 +210,10 @@ class Executor:
                 _telemetry.hooks.compile_event(
                     "executor.eval", seconds=time.perf_counter() - t0,
                     n_args=len(vals))
+            if _profiling._ENABLED:
+                _profiling.capture_jit(
+                    "executor.eval", self._fwd_jit, (vals,),
+                    key=("executor", id(self), "eval"), kind="executor")
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 

@@ -37,6 +37,7 @@ import jax
 
 from .. import autograd
 from .. import ndarray as nd_mod
+from .. import profiling as _profiling
 from .. import random as _random_mod
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -609,6 +610,23 @@ class HybridBlock(Block):
                                              rng_key)
         else:
             outs, aux = entry.fwd_eval(pvals, ivals, rng_key)
+        if _profiling._ENABLED:
+            # lazy cost capture (mx.profiling): keyed on the same
+            # static fields as the hybridize cache, so each compiled
+            # specialization yields exactly one CostReport
+            ckey = ("hybrid", type(self).__name__, bool(do_grad)) + \
+                tuple((a.shape, str(a.dtype)) for a in args)
+            if do_grad:
+                _profiling.capture_jit(
+                    "hybrid:%s:train" % type(self).__name__,
+                    entry.fwd_vjp,
+                    (diff_vals, nondiff_vals, ivals, rng_key),
+                    key=ckey, kind="hybrid_cache")
+            else:
+                _profiling.capture_jit(
+                    "hybrid:%s" % type(self).__name__, entry.fwd_eval,
+                    (pvals, ivals, rng_key), key=ckey,
+                    kind="hybrid_cache")
 
         # rebind aux state (functional running stats -> parameter)
         for p in entry.aux_params:

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import profiling as _profiling
 from .. import random as _random_mod
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -879,6 +880,17 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
         else:
             raw = dispatch()
             result = _wrap_outputs(op, raw, None, None, params)
+
+    if _profiling._ENABLED and jfn is not None and \
+            not any(isinstance(d, bulk.LazyData) or _is_traced(d)
+                    for d in pdatas):
+        # lazy cost capture (mx.profiling): a dict insert keyed on the
+        # eager-jit cache sig; lower+compile+parse happens at report
+        # time, never here
+        cargs = ((dyn_vals, key) + tuple(pdatas)) if op.stateful_rng \
+            else ((dyn_vals,) + tuple(pdatas))
+        _profiling.capture_jit("eager:%s" % op.name, jfn, cargs,
+                               key=("eager", sig), kind="eager_jit")
 
     if out is not None:
         src = result if not isinstance(result, list) else result[0]

@@ -22,7 +22,14 @@ _OPT_REGISTRY = {}
 
 
 def register(klass):
-    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    # loud on duplicates: two same-named definitions silently
+    # overwriting each other is exactly how the host-syncing LARS copy
+    # shadowed the trace-safe one for five PRs
+    key = klass.__name__.lower()
+    if key in _OPT_REGISTRY and _OPT_REGISTRY[key] is not klass:
+        raise MXNetError("duplicate optimizer registration %r "
+                         "(already %r)" % (key, _OPT_REGISTRY[key]))
+    _OPT_REGISTRY[key] = klass
     return klass
 
 
@@ -234,8 +241,13 @@ class SGD(Optimizer):
 class LARS(Optimizer):
     """Layer-wise Adaptive Rate Scaling for large-batch SGD (reference:
     ``optimizer/contrib :: LARS``; BASELINE config 5).  Dispatches to the
-    fused ``lars_update`` op (trust ratio + momentum step in one
-    program)."""
+    fused ``lars_update`` op (trust ratio + momentum step in ONE traced
+    program) -- the trust ratio never leaves the device, so the update is
+    trace-safe inside ``jit``/``TrainStep`` (no host-syncing
+    ``.asscalar()``: the former second definition of this class computed
+    the ratio on the host and raised ``TracerArrayConversionError``
+    under trace; it is gone, and ``opt.create('lars')`` is pinned to
+    this implementation by test)."""
 
     def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9,
                  skip_list=("bias", "gamma", "beta"), **kwargs):
@@ -468,44 +480,6 @@ class LAMB(Optimizer):
             kw2["upper_bound"] = self.upper_bound
         w = nd.lamb_update_phase2(weight, g, r1, r2, **kw2)
         weight._data, mean._data, var._data = w._data, m._data, v._data
-
-
-@register
-class LARS(Optimizer):
-    """Layer-wise adaptive rate scaling for large-batch SGD (reference:
-    v1.6 ``optimizer/contrib :: LARS`` via ``multi_lars``/``multi_sum_sq``;
-    BASELINE config 5)."""
-
-    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-9, **kwargs):
-        super().__init__(**kwargs)
-        self.momentum = momentum
-        self.eta = eta
-        self.epsilon = epsilon
-
-    def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, dtype=weight.dtype, ctx=weight.context)
-        return None
-
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        w_norm = float(weight.norm().asscalar())
-        g_norm = float((grad * self.rescale_grad).norm().asscalar())
-        if w_norm > 0 and g_norm > 0:
-            trust = self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon)
-        else:
-            trust = 1.0
-        kw = {"lr": lr * trust, "wd": wd, "rescale_grad": self.rescale_grad}
-        if self.clip_gradient is not None:
-            kw["clip_gradient"] = self.clip_gradient
-        if state is not None:
-            w, m = nd.sgd_mom_update(weight, grad, state,
-                                     momentum=self.momentum, **kw)
-            weight._data, state._data = w._data, m._data
-        else:
-            weight._data = nd.sgd_update(weight, grad, **kw)._data
 
 
 class Updater:

@@ -20,6 +20,7 @@ batch slicing, NCCL allreduce):
 from __future__ import annotations
 
 import contextlib
+import time
 
 import numpy as np
 
@@ -29,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import profiling as _profiling
 from .. import random as _random_mod
 
 __all__ = ["replicate_block", "shard_batch", "split_and_load", "TrainStep"]
@@ -447,7 +449,12 @@ class TrainStep:
         # the jit donates the param/state buffers; any still-pending
         # bulked-eager region referencing them must execute first
         _bulk.flush()
+        t0p = time.perf_counter() if _profiling._ENABLED else None
         new_w, new_s, _t, losses = fn(*args)
+        if t0p is not None:
+            label = "train_scan:%s" % type(self._block).__name__
+            self._profiling_hook(label, fn, t0p,
+                                 time.perf_counter() - t0p, k * bs)
         for n in pnames:
             pmap[n]._data._data = new_w[n]
         for i in idxs:
@@ -463,6 +470,26 @@ class TrainStep:
                 p._data = NDArray(new_w[p.name])
                 p._data._grad = grad
         return NDArray(losses)
+
+    def _profiling_hook(self, label, fn, t0, dispatch_s, items):
+        """mx.profiling capture for one dispatched step: register the
+        compiled program for lazy cost analysis, feed the roofline's
+        step clock, and drop a timeline span.  On a synchronous backend
+        (CPU CI) the dispatch wall IS the step time; on async TPU
+        dispatch the steady-state loop is back-pressured by buffer
+        donation, so per-call wall converges to step time -- callers
+        with externally synced windows can refine via
+        ``profiling.record_step``."""
+        from ..profiling import timeline
+        _profiling.capture_jit(label, fn, self._last_call[1],
+                               key=("train_step", id(fn)),
+                               kind="train_step")
+        _profiling.record_step(label, dispatch_s, items=items)
+        timeline.record(label, t0, dispatch_s,
+                        {"items": items, "donated": self._donate})
+        if self._donate:
+            timeline.instant(label + ".donate",
+                             {"buffers": "params+opt_state"})
 
     def cost_analysis(self):
         """XLA's cost analysis of the most recently dispatched compiled
@@ -569,7 +596,12 @@ class TrainStep:
         # the jit donates the param/state buffers; any still-pending
         # bulked-eager region referencing them must execute first
         _bulk.flush()
+        t0p = time.perf_counter() if _profiling._ENABLED else None
         new_w, new_s, aux, mean_loss, all_finite = fn(*args)
+        if t0p is not None:
+            label = "train_step:%s" % type(self._block).__name__
+            self._profiling_hook(label, fn, t0p,
+                                 time.perf_counter() - t0p, bs)
         if scaler is not None:
             # host sync only in fp16 mode: the scaler's growth/backoff
             # counters live on the host (reference LossScaler semantics)

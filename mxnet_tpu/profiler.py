@@ -93,11 +93,62 @@ def dump(finished=True, profile_process="worker"):
     return _trace_dir
 
 
+_DUMPS_SORT_KEYS = ("total", "avg", "min", "max", "count", "flops",
+                    "bytes", "peak_hbm")
+
+
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    """Reference: ``profiler.dumps`` (aggregate stats).  Aggregation
-    lives in the TensorBoard profile; this returns a pointer string."""
-    return ("profile trace: %s (load with TensorBoard's profile plugin)"
-            % (_trace_dir or "<not started>"))
+    """Reference: ``profiler.dumps`` (aggregate stats) -- REAL per-
+    executable aggregates from the mx.profiling CostReport store, not a
+    pointer string.  One row per captured compiled program: step
+    count/total/avg (host wall), FLOPs, bytes accessed, peak HBM.
+
+    ``sort_by`` follows the reference's keys (``total``/``avg``/
+    ``min``/``max``/``count`` over step time) plus cost-side keys
+    (``flops``/``bytes``/``peak_hbm``); ``format`` is ``table`` or
+    ``json``; ``reset=True`` clears the store after rendering."""
+    if sort_by not in _DUMPS_SORT_KEYS:
+        raise MXNetError("profiler.dumps: sort_by must be one of %s"
+                         % (_DUMPS_SORT_KEYS,))
+    if format not in ("table", "json"):
+        raise MXNetError("profiler.dumps: format must be 'table' or "
+                         "'json'")
+    from . import profiling
+    rows = []
+    for rep in profiling.reports():
+        st = rep.get("step") or {}
+        count = st.get("count", 0)
+        total = st.get("total_s", 0.0) or 0.0
+        rows.append({
+            "name": rep["label"],
+            "count": count,
+            "total": total,
+            "avg": (total / count) if count else 0.0,
+            "min": st.get("min_s") or 0.0,
+            "max": st.get("max_s") or 0.0,
+            "flops": rep["totals"]["flops"],
+            "bytes": rep["totals"]["bytes_accessed"],
+            "peak_hbm": rep["memory"]["peak_hbm_bytes"],
+        })
+    rows.sort(key=lambda r: r[sort_by], reverse=not ascending)
+    if reset:
+        profiling.reset()
+    if format == "json":
+        import json
+        return json.dumps(rows, indent=1, sort_keys=True)
+    lines = ["Profile Statistics (mx.profiling cost reports):",
+             "%-36s %8s %12s %12s %14s %14s %12s"
+             % ("Name", "Count", "Total(ms)", "Avg(ms)", "FLOPs",
+                "Bytes", "PeakHBM")]
+    for r in rows:
+        lines.append("%-36s %8d %12.3f %12.3f %14.3g %14.3g %12d"
+                     % (r["name"][:36], r["count"], 1e3 * r["total"],
+                        1e3 * r["avg"], r["flops"], r["bytes"],
+                        r["peak_hbm"]))
+    if not rows:
+        lines.append("(no cost reports captured; enable with "
+                     "MXNET_TPU_PROFILING=1 / mx.profiling.enable())")
+    return "\n".join(lines)
 
 
 def state():
@@ -106,13 +157,25 @@ def state():
 
 @contextlib.contextmanager
 def scope(name):
-    """Named region; shows up in the XLA device trace (reference:
-    profiler scope in ``MXNET_PROFILER_SCOPE``)."""
-    if not _scopes_enabled:
+    """Named region.  Shows up in the XLA device trace (reference:
+    profiler scope in ``MXNET_PROFILER_SCOPE``), AND -- via
+    ``jax.named_scope`` -- in the ``op_name`` metadata of any HLO
+    traced inside it, which is how framework provenance reaches the
+    mx.profiling CostReport's per-scope attribution.  With
+    mx.profiling enabled it also lands as a span on the step
+    timeline."""
+    from . import profiling as _profiling
+    if not _scopes_enabled and not _profiling._ENABLED:
         yield
         return
-    import jax
-    with jax.profiler.TraceAnnotation(name):
+    with contextlib.ExitStack() as stack:
+        if _scopes_enabled:
+            import jax
+            stack.enter_context(jax.profiler.TraceAnnotation(name))
+            stack.enter_context(jax.named_scope(name))
+        if _profiling._ENABLED:
+            from .profiling import timeline
+            stack.enter_context(timeline.span(name))
         yield
 
 

@@ -1,0 +1,7 @@
+"""``python -m mxnet_tpu.profiling`` == the ``mxprof`` CLI."""
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Analytic roofline: measured step time x CostReport -> bound labels.
+
+Given a CostReport and a measured step time, computes achieved FLOP/s
+and bytes/s against the device's peak compute and HBM bandwidth, and
+labels every HLO category compute- or memory-bound by comparing its
+arithmetic intensity (FLOPs per byte moved) with the device's ridge
+point ``peak_flops / peak_bandwidth``.  This is how an aggregate MFU
+number decomposes into "the convs are compute-bound at X%, the
+layout ops are pure bandwidth": the ceiling analysis ROADMAP item 2
+asks for.
+
+Peaks come from a device-kind table (TPU generations) or conservative
+assumed defaults (CPU/dev boxes) -- ``peaks_assumed`` in the output
+says which, so a CI roofline is never mistaken for chip truth.
+"""
+from __future__ import annotations
+
+# (peak bf16 FLOP/s, peak HBM bytes/s) by device-kind prefix.  Sources:
+# published TPU spec sheets; the bench's MFU table uses the same FLOPs.
+_DEVICE_PEAKS = (
+    ("TPU v5 lite", 197e12, 819e9),
+    ("TPU v5e", 197e12, 819e9),
+    ("TPU v5", 459e12, 2765e9),
+    ("TPU v4", 275e12, 1228e9),
+    ("TPU v3", 123e12, 900e9),
+    ("TPU v2", 45e12, 700e9),
+)
+
+# dev-box fallback so the roofline SECTION always renders (CI runs on
+# CPU); flagged assumed=True and sized for a generic server core
+_ASSUMED_PEAKS = (5e11, 5e10)
+
+
+def device_peaks(device_kind=None):
+    """(peak_flops, peak_bytes_per_s, assumed) for the current (or
+    named) device kind."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = ""
+    for prefix, fl, bw in _DEVICE_PEAKS:
+        if device_kind.startswith(prefix):
+            return fl, bw, False
+    return _ASSUMED_PEAKS[0], _ASSUMED_PEAKS[1], True
+
+
+def build(report, step_time_s, peak_flops=None, peak_bytes_per_s=None,
+          items_per_step=None):
+    """Roofline section dict for ``report`` at ``step_time_s``."""
+    fl, bw, assumed = device_peaks(report.get("device"))
+    if peak_flops is not None:
+        fl, assumed = peak_flops, False
+    if peak_bytes_per_s is not None:
+        bw = peak_bytes_per_s
+    step_time_s = max(float(step_time_s), 1e-12)
+    tot_f = report["totals"]["flops"]
+    tot_b = report["totals"]["bytes_accessed"]
+    achieved_f = tot_f / step_time_s
+    achieved_b = tot_b / step_time_s
+    ridge = fl / bw
+    cats = {}
+    time_est = {}
+    for name, c in report["categories"].items():
+        f, b = c["flops"], c["bytes"]
+        if f == 0 and b == 0:
+            continue
+        intensity = (f / b) if b else float("inf")
+        bound = "compute" if intensity >= ridge else "memory"
+        # the category's floor time under the roofline model: whichever
+        # wall (compute or bandwidth) it hits first
+        time_est[name] = max(f / fl, b / bw)
+        cats[name] = {"intensity": round(intensity, 3)
+                      if intensity != float("inf") else None,
+                      "bound": bound}
+    t_total = sum(time_est.values()) or 1.0
+    for name, t in time_est.items():
+        cats[name]["time_share"] = round(t / t_total, 4)
+        cats[name]["floor_s"] = round(t, 9)
+    out = {
+        "step_time_s": step_time_s,
+        "peak_flops": fl,
+        "peak_bytes_per_s": bw,
+        "peaks_assumed": assumed,
+        "ridge_intensity": round(ridge, 3),
+        "achieved_flops_per_s": achieved_f,
+        "achieved_bytes_per_s": achieved_b,
+        "mfu": round(achieved_f / fl, 4),
+        "bandwidth_util": round(achieved_b / bw, 4),
+        # the roofline's floor for this program on this chip: the
+        # measured/floor ratio says how much headroom is model-side
+        "floor_step_s": round(t_total if time_est else 0.0, 9),
+        "categories": cats,
+    }
+    if items_per_step:
+        out["items_per_step"] = items_per_step
+        out["items_per_sec"] = round(items_per_step / step_time_s, 1)
+    return out

@@ -1,0 +1,185 @@
+"""In-process CostReport store: lazy capture specs, step times,
+persistence.
+
+The hot paths (``ndarray.invoke``, ``HybridBlock._run_cached``,
+``Executor.forward``, ``TrainStep``) call ``register()`` with a jitted
+callable + abstracted example args -- a dict insert, nothing else.  The
+expensive part (``fn.lower().compile()`` -- which hits jax's executable
+cache for anything already dispatched -- plus HLO parsing) runs at
+``reports()`` / ``save()`` time, off the training path.
+
+Step wall times recorded via ``record_step()`` attach per-label step
+stats and a roofline section to the matching reports.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .. import sync as _sync
+from . import cost, roofline
+
+COMBINED_SCHEMA = "mxprof.report.v1"
+COMBINED_NAME = "report.json"
+
+_lock = _sync.Lock(name="profiling.store")
+_pending = {}      # key -> spec dict (label, fn, args, kind, meta)
+_reports = {}      # key -> CostReport dict
+_failed = set()    # keys whose lowering failed (don't retry forever)
+_steps = {}        # label -> {"count","total_s","min_s","max_s","items"}
+
+
+def register(key, label, fn, args, kind="jit", **meta):
+    """Queue one executable for lazy analysis (dedupes on ``key``)."""
+    with _lock:
+        if key in _pending or key in _reports or key in _failed:
+            return
+    import jax
+
+    def _abstract(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype") and \
+                not isinstance(x, jax.ShapeDtypeStruct):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+    try:
+        specs = jax.tree_util.tree_map(_abstract, args)
+    except Exception:
+        return
+    with _lock:
+        if key not in _pending and key not in _reports:
+            _pending[key] = {"label": label, "fn": fn, "args": specs,
+                             "kind": kind, "meta": meta}
+
+
+def record_step(label, seconds, items=None):
+    seconds = float(seconds)
+    with _lock:
+        st = _steps.setdefault(label, {"count": 0, "total_s": 0.0,
+                                       "min_s": None, "max_s": None,
+                                       "items": 0})
+        st["count"] += 1
+        st["total_s"] += seconds
+        st["min_s"] = seconds if st["min_s"] is None \
+            else min(st["min_s"], seconds)
+        st["max_s"] = seconds if st["max_s"] is None \
+            else max(st["max_s"], seconds)
+        if items:
+            st["items"] += int(items)
+    from .. import telemetry as _telemetry
+    if _telemetry._ENABLED:
+        _telemetry.hooks.profiling_step(label, seconds)
+
+
+def step_stats(label=None):
+    with _lock:
+        if label is not None:
+            return dict(_steps.get(label, {}))
+        return {k: dict(v) for k, v in _steps.items()}
+
+
+def _materialize():
+    """Analyze every pending spec (outside the lock: lowering can take
+    a while and must not block the hot-path register)."""
+    with _lock:
+        todo = list(_pending.items())
+        for k, _v in todo:
+            del _pending[k]
+    from .. import telemetry as _telemetry
+    for key, spec in todo:
+        t0 = time.perf_counter()
+        rep = cost.analyze_jit(spec["fn"], spec["args"],
+                               label=spec["label"], kind=spec["kind"],
+                               **spec["meta"])
+        dt = time.perf_counter() - t0
+        if rep is None:
+            with _lock:
+                _failed.add(key)
+            continue
+        with _lock:
+            _reports[key] = rep
+        if _telemetry._ENABLED:
+            _telemetry.hooks.profiling_capture(
+                spec["label"], dt, flops=rep["totals"]["flops"])
+
+
+def _annotate(rep):
+    """Attach step stats + roofline when step times exist for the
+    report's label."""
+    st = _steps.get(rep["label"])
+    if not st or not st["count"]:
+        return rep
+    mean = st["total_s"] / st["count"]
+    rep = dict(rep)
+    rep["step"] = {"count": st["count"], "mean_s": mean,
+                   "min_s": st["min_s"], "max_s": st["max_s"],
+                   "total_s": st["total_s"]}
+    items = (st["items"] / st["count"]) if st.get("items") else None
+    rep["roofline"] = roofline.build(rep, mean, items_per_step=items)
+    return rep
+
+
+def reports():
+    """All CostReports, annotated, insertion-ordered."""
+    _materialize()
+    with _lock:
+        reps = list(_reports.values())
+        steps_snapshot = bool(_steps)
+    return [(_annotate(r) if steps_snapshot else r) for r in reps]
+
+
+def combined():
+    """The combined artifact ``mxprof report`` / ``diff`` consume."""
+    reps = reports()
+    rollup = {}
+    tot_f = tot_b = 0.0
+    peak_hbm = 0
+    for r in reps:
+        tot_f += r["totals"]["flops"]
+        tot_b += r["totals"]["bytes_accessed"]
+        peak_hbm = max(peak_hbm, r["memory"]["peak_hbm_bytes"])
+        for c, v in r["categories"].items():
+            agg = rollup.setdefault(c, {"flops": 0, "bytes": 0,
+                                        "instructions": 0})
+            agg["flops"] += v["flops"]
+            agg["bytes"] += v["bytes"]
+            agg["instructions"] += v["instructions"]
+    return {
+        "schema": COMBINED_SCHEMA,
+        "steps": step_stats(),
+        "executables": reps,
+        "totals": {"flops": tot_f, "bytes_accessed": tot_b,
+                   "peak_hbm_bytes": peak_hbm},
+        "categories": rollup,
+    }
+
+
+def _safe_name(label):
+    return "".join(ch if ch.isalnum() or ch in "._-" else "_"
+                   for ch in label) or "report"
+
+
+def save(dirpath=None):
+    """Write per-executable ``<label>.cost.json`` files and the
+    combined ``report.json``; returns the combined path."""
+    from . import report_dir
+    dirpath = dirpath or report_dir() or "mxprof_reports"
+    os.makedirs(dirpath, exist_ok=True)
+    comb = combined()
+    for rep in comb["executables"]:
+        path = os.path.join(dirpath,
+                            _safe_name(rep["label"]) + ".cost.json")
+        with open(path, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+    out = os.path.join(dirpath, COMBINED_NAME)
+    with open(out, "w") as f:
+        json.dump(comb, f, indent=1, sort_keys=True)
+    return out
+
+
+def clear():
+    with _lock:
+        _pending.clear()
+        _reports.clear()
+        _failed.clear()
+        _steps.clear()

@@ -47,6 +47,9 @@ def _detect():
         # concurrency sanitizer (mx.sync): LIVE arm state, same
         # contract as the TELEMETRY row
         "TSAN": _tsan_enabled(),
+        # compiled-step cost accounting (mx.profiling): LIVE enable
+        # state, same contract as the TELEMETRY row
+        "PROFILING": _profiling_enabled(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -59,6 +62,11 @@ def _telemetry_enabled():
 def _tsan_enabled():
     from . import sync
     return sync.tsan_enabled()
+
+
+def _profiling_enabled():
+    from . import profiling
+    return profiling.enabled()
 
 
 def _try_import(mod):

@@ -70,6 +70,14 @@ sync.watchdog_fires    counter deadlock-watchdog expiries (TSAN only)
 sync.inversions        counter lock-order inversions observed (TSAN
                                report-only mode records instead of
                                raising)
+profiling.reports      counter CostReports materialized by the
+                               mx.profiling store
+profiling.capture_time timer   wall time lowering/parsing one report
+profiling.capture      event   one per report, payload carries
+                               label + FLOPs
+profiling.step_time    timer   per-dispatch step wall recorded by
+                               TrainStep under MXNET_TPU_PROFILING=1
+                               (feeds the roofline)
 =====================  ======  =========================================
 """
 from __future__ import annotations
@@ -80,6 +88,7 @@ __all__ = [
     "feed_wait", "feed_overlap", "amp_overflow", "amp_rescale",
     "checkpoint", "checkpoint_wait",
     "sync_contention", "sync_hold", "sync_watchdog", "sync_inversion",
+    "profiling_capture", "profiling_step",
 ]
 
 
@@ -218,3 +227,18 @@ def sync_inversion(outer, inner):
     reg = _registry()
     reg.counter("sync.inversions").inc()
     reg.event("sync.inversion").emit(outer=outer, inner=inner)
+
+
+def profiling_capture(label, seconds, flops=None):
+    """One CostReport was materialized by the mx.profiling store."""
+    reg = _registry()
+    reg.counter("profiling.reports").inc()
+    reg.timer("profiling.capture_time").observe(seconds, label=label)
+    reg.event("profiling.capture").emit(label=label, seconds=seconds,
+                                        flops=flops)
+
+
+def profiling_step(label, seconds):
+    """One step wall time recorded for the roofline clock."""
+    _registry().timer("profiling.step_time").observe(seconds,
+                                                     label=label)

@@ -78,6 +78,57 @@ def test_lars_runs():
     assert (got < 1.0).all()
 
 
+def test_lars_single_trace_safe_registration():
+    """ISSUE 6 satellite (ROADMAP item 1): the two ``class LARS``
+    definitions are merged -- ``opt.create('lars')`` is pinned to the
+    in-graph fused-op implementation (skip_list kept; no host-syncing
+    ``.asscalar()`` trust ratio)."""
+    import inspect
+    o = opt.create("lars", learning_rate=0.1)
+    assert o.skip_list == ("bias", "gamma", "beta")
+    src = inspect.getsource(type(o).update)
+    assert "asscalar" not in src, "host-syncing LARS copy resurfaced"
+    assert "lars_update" in src
+    # exactly one LARS definition in the module
+    import mxnet_tpu.optimizer.optimizer as om
+    count = inspect.getsource(om).count("class LARS")
+    assert count == 1, "duplicate class LARS definitions: %d" % count
+
+
+def test_lars_runs_in_graph_under_jit():
+    """The merged LARS must trace: a whole compiled TrainStep (fwd +
+    bwd + LARS update in ONE jit program) runs without
+    TracerArrayConversionError and moves the weights."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import TrainStep
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "lars",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), tr, mesh=None)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(8, 6).astype(np.float32))
+    y = mx.nd.array(rng.rand(8, 4).astype(np.float32))
+    losses = [float(step(x, y).asscalar())]   # materializes deferred init
+    before = [p.data().asnumpy().copy()
+              for p in net.collect_params().values()]
+    losses += [float(step(x, y).asscalar()) for _ in range(4)]
+    after = [p.data().asnumpy()
+             for p in net.collect_params().values()]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_optimizer_register_rejects_duplicates():
+    with pytest.raises(mx.MXNetError):
+        @opt.optimizer.register
+        class SGD:   # noqa: F811 -- the point of the test
+            pass
+
+
 def test_clip_gradient():
     o = opt.create("sgd", learning_rate=1.0, clip_gradient=0.1)
     got = _run_steps(o, np.zeros(1, np.float32), [np.array([10.0], np.float32)])

@@ -23,7 +23,9 @@ def test_profiler_trace_lifecycle(tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         found.extend(files)
     assert found, "no trace files written"
-    assert "profile trace" in mx.profiler.dumps()
+    # dumps() now returns real aggregate stats (mx.profiling store),
+    # not a pointer string
+    assert "Profile Statistics" in mx.profiler.dumps()
 
 
 def test_profiler_bad_config():
@@ -166,3 +168,66 @@ def test_profiler_counter_domain_naming():
     c.increment()
     assert mx.profiler.Counter(d, "reads").value == 3
     mx.profiler.reset()
+
+
+def test_profiler_dumps_real_aggregates_with_sort_and_format():
+    """ISSUE 6 satellite: dumps() returns real per-executable stats
+    from the CostReport store, honoring format=/sort_by=/ascending=."""
+    import json
+    from mxnet_tpu import profiling
+    profiling.reset()
+    profiling.enable()
+    try:
+        mx.nd.clip(mx.nd.ones((4, 4)), a_min=0.31, a_max=8.7).asnumpy()
+        mx.nd.dot(mx.nd.ones((32, 32)), mx.nd.ones((32, 32))).asnumpy()
+        table = mx.profiler.dumps()
+        assert "Profile Statistics" in table
+        assert "eager:dot" in table and "eager:clip" in table
+        rows = json.loads(mx.profiler.dumps(format="json",
+                                            sort_by="flops"))
+        assert len(rows) >= 2
+        flops = [r["flops"] for r in rows]
+        assert flops == sorted(flops, reverse=True)   # descending
+        rows_asc = json.loads(mx.profiler.dumps(format="json",
+                                                sort_by="flops",
+                                                ascending=True))
+        assert [r["flops"] for r in rows_asc] == sorted(flops)
+        # the dot row dominates the clip row in flops
+        by = {r["name"]: r for r in rows}
+        assert by["eager:dot"]["flops"] > by["eager:clip"]["flops"]
+        with pytest.raises(mx.MXNetError):
+            mx.profiler.dumps(sort_by="bogus")
+        with pytest.raises(mx.MXNetError):
+            mx.profiler.dumps(format="xml")
+        # reset=True clears the store
+        mx.profiler.dumps(reset=True)
+        assert json.loads(mx.profiler.dumps(format="json")) == []
+    finally:
+        profiling.disable()
+        profiling.reset()
+
+
+def test_profiler_pause_resume(tmp_path):
+    """Direct pause()/resume() coverage: pause turns scopes off while
+    the trace keeps running; resume re-arms them only in 'run' state."""
+    mx.profiler.set_config(filename=str(tmp_path / "pr.json"))
+    assert not mx.profiler._scopes_enabled
+    # resume while stopped must NOT arm scopes
+    mx.profiler.resume()
+    assert not mx.profiler._scopes_enabled
+    mx.profiler.start()
+    try:
+        assert mx.profiler._scopes_enabled
+        mx.profiler.pause()
+        assert not mx.profiler._scopes_enabled
+        assert mx.profiler.state() == "run"     # trace still running
+        # a scope entered while paused is a no-op (no annotation cm)
+        with mx.profiler.scope("paused_region"):
+            pass
+        mx.profiler.resume()
+        assert mx.profiler._scopes_enabled
+        with mx.profiler.scope("resumed_region"):
+            mx.nd.ones((2,)).asnumpy()
+    finally:
+        mx.profiler.stop()
+    assert mx.profiler.state() == "stop"
