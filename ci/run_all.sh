@@ -18,6 +18,16 @@
 #                report must show non-empty step + category sections
 #                and mxprof diff of the run against itself must report
 #                zero drift (the regression-attribution contract)
+#   shardlint -> sharding sanitizer gates (docs/sharding.md): the
+#                full-tree static pass (mesh axes, shard_map arity,
+#                donation audit, implicit reshard), then a LeNet
+#                TrainStep smoke over an 8-way dp mesh whose GSPMD
+#                collectives must match the committed
+#                ci/sharding_baseline.json exactly (an unblessed
+#                all-gather fails naming the executable and kind),
+#                with the steady-state steps run under
+#                transfer_guard("disallow") and a seeded implicit
+#                host transfer proven to raise
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -26,7 +36,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -301,6 +311,76 @@ EOF
     # gate 2: a run diffed against itself must report ZERO drift
     python -m mxnet_tpu.profiling diff "$pdir/report.json" "$pdir/report.json"
     rm -rf "$pdir"
+}
+
+run_shardlint() {
+    log "shardlint: full-tree sharding pass (mesh axes, shard_map arity, donation, reshard)"
+    # the sharding rules ride the same framework as the lint stage;
+    # running --self here keeps this stage self-contained when invoked
+    # alone (ci/run_all.sh shardlint)
+    python -m mxnet_tpu.analysis --self --json
+    log "shardlint: collective-contract + transfer-guard gate (LeNet TrainStep over dp mesh)"
+    sdir=$(mktemp -d /tmp/mxtpu_shard_ci.XXXXXX)
+    JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        MXNET_TPU_SHARD_CHECK=1 python - "$sdir" <<'EOF'
+import os, sys
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiling
+from mxnet_tpu.analysis import sharding
+from mxnet_tpu.parallel import TrainStep, make_mesh
+
+sdir = sys.argv[1]
+assert profiling.enabled(), "MXNET_TPU_SHARD_CHECK=1 did not arm capture"
+assert mx.runtime.Features().is_enabled("SHARD_CHECK")
+
+mesh = make_mesh({"dp": 8})
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Conv2D(6, 5, padding=2, activation="relu"),
+        gluon.nn.MaxPool2D(2),
+        gluon.nn.Conv2D(16, 3, activation="relu"),
+        gluon.nn.MaxPool2D(2),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr, mesh=mesh)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(16, 1, 16, 16).astype(np.float32))
+y = mx.nd.array(rng.randint(0, 10, (16,)).astype(np.float32))
+step(x, y)                               # compile + state init, unguarded
+
+# steady-state steps under the transfer guard: the compiled step must
+# be free of IMPLICIT host transfers (scalar feeds ride device_put)
+with sharding.transfer_guard("disallow"):
+    for _ in range(2):
+        loss = step(x, y)
+    loss._data.block_until_ready()
+
+# and a seeded in-step leak must raise -- the guard is live, not a no-op
+try:
+    with sharding.transfer_guard("disallow"):
+        (loss * 1.5)._data.block_until_ready()   # py scalar -> implicit h2d
+except Exception:
+    pass
+else:
+    raise SystemExit("transfer guard did not catch the seeded host transfer")
+
+cur = sharding.save_contract(os.path.join(sdir, "current.json"))
+label = "train_step:HybridSequential"
+assert label in cur["executables"], cur["executables"].keys()
+print("shardlint smoke ok: %s collectives %s"
+      % (label, cur["executables"][label]))
+EOF
+    # gate: the smoke's GSPMD collectives vs the committed baseline --
+    # an unblessed kind or a grown count exits 1 naming executable+kind
+    python -m mxnet_tpu.analysis --collective-diff \
+        ci/sharding_baseline.json "$sdir/current.json" --json
+    rm -rf "$sdir"
 }
 
 run_bench() {

@@ -89,6 +89,19 @@ if _os.environ.get("MXNET_TPU_COMPILATION_CACHE", "1") != "0":
     except Exception:
         pass
 
+# Transfer guard (sharding sanitizer runtime wiring): with
+# MXNET_TPU_TRANSFER_GUARD=disallow, an IMPLICIT host<->device transfer
+# inside the step -- a Python scalar leaking into dispatch, an un-placed
+# index array -- raises at the transfer instead of silently stalling the
+# pipeline behind a device round-trip every iteration.  Applied before
+# any framework dispatch so import-time ops are covered too; a bad mode
+# string fails loudly here (jax names the valid options).  Scoped use:
+# mxnet_tpu.analysis.sharding.transfer_guard(mode).  docs/sharding.md.
+_transfer_guard_mode = _os.environ.get("MXNET_TPU_TRANSFER_GUARD", "")
+if _transfer_guard_mode:
+    import jax as _jax_guard
+    _jax_guard.config.update("jax_transfer_guard", _transfer_guard_mode)
+
 from . import base
 from .base import MXNetError
 from . import sync

@@ -22,6 +22,15 @@ device time is spent (docs/analysis.md):
   thread discipline (``unguarded-shared-write``, ``blocking-under-lock``,
   ``bare-thread``, ``sleep-poll``).  Runtime closure:
   ``MXNET_TPU_TSAN=1`` (``mxnet_tpu.sync``, docs/concurrency.md).
+- :func:`audit_sharding` / the sharding sanitizer (docs/sharding.md) --
+  SPMD spec linting (``mesh-axis-unknown``, ``shard-map-spec-arity``,
+  ``implicit-reshard``), the donation audit (``undonated-train-state``,
+  ``donated-reuse``), and the compiled layer:
+  :func:`collective_contract`/:func:`diff_contract` extract GSPMD
+  collective counts/bytes per registered executable and gate them
+  against the committed ``ci/sharding_baseline.json``
+  (``collective-drift``); :func:`transfer_guard` makes silent in-step
+  host transfers raise.
 
 CLI: ``python -m mxnet_tpu.analysis`` (or the ``mxlint`` entry point);
 ``ci/run_all.sh lint`` runs it with ``--self``.  Add a rule with
@@ -34,6 +43,9 @@ from .trace_lint import lint_file, lint_paths, lint_source
 from . import state_write  # noqa: F401  (registers bare-state-write)
 from .concurrency import audit_lock_order, static_order_edges
 from .retrace import audit_retrace
+from .sharding import (audit_sharding, collective_contract,
+                       collective_profile, diff_contract, load_contract,
+                       save_contract, transfer_guard)
 from .cli import main
 
 __all__ = [
@@ -41,5 +53,8 @@ __all__ = [
     "render_human", "render_json", "ERROR", "WARNING",
     "GraphCheckError", "assert_graph_ok", "check_symbol",
     "lint_file", "lint_paths", "lint_source",
-    "audit_lock_order", "static_order_edges", "audit_retrace", "main",
+    "audit_lock_order", "static_order_edges", "audit_retrace",
+    "audit_sharding", "collective_contract", "collective_profile",
+    "diff_contract", "load_contract", "save_contract", "transfer_guard",
+    "main",
 ]

@@ -33,8 +33,9 @@ def _build_parser():
     ap = argparse.ArgumentParser(
         prog="mxlint",
         description="Static graph checker + trace-safety linter + "
-                    "concurrency sanitizer + retrace auditor for "
-                    "mxnet_tpu (docs/analysis.md).")
+                    "concurrency sanitizer + sharding sanitizer + "
+                    "retrace auditor for mxnet_tpu (docs/analysis.md, "
+                    "docs/sharding.md).")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint")
     ap.add_argument("--self", dest="self_check", action="store_true",
@@ -64,6 +65,12 @@ def _build_parser():
     ap.add_argument("--retrace", action="store_true",
                     help="audit registry op params against the "
                          "hybridize cache key")
+    ap.add_argument("--collective-diff", nargs=2,
+                    metavar=("BASELINE", "CURRENT"),
+                    help="diff two collective-contract JSONs (written "
+                         "by analysis.sharding.save_contract) and fail "
+                         "on unblessed GSPMD collectives -- the CI "
+                         "shardlint gate (docs/sharding.md)")
     ap.add_argument("--disable", default="", metavar="RULES",
                     help="comma-separated rule ids to skip")
     ap.add_argument("--json", dest="as_json", action="store_true",
@@ -142,7 +149,7 @@ def _write_baseline(path, diags: List[Diagnostic]):
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # importing the passes registers their rules
-    from . import concurrency, graph_check, retrace, trace_lint
+    from . import concurrency, graph_check, retrace, sharding, trace_lint
 
     if args.list_rules:
         print(_list_rules())
@@ -181,6 +188,10 @@ def main(argv=None) -> int:
             conc_paths = [p for p in SELF_PATHS if os.path.exists(p)]
         diags.extend(concurrency.audit_lock_order(
             conc_paths, ignore=ignore, report_files=report_files))
+        # mesh-axis declarations span files the same way lock-order
+        # edges do: scan the whole tree, report into the scoped set
+        diags.extend(sharding.audit_sharding(
+            conc_paths, ignore=ignore, report_files=report_files))
 
     for gpath in args.graph:
         from ..symbol import load as sym_load
@@ -201,8 +212,20 @@ def main(argv=None) -> int:
         diags.extend(d for d in retrace.audit_retrace()
                      if d.rule not in ignore)
 
+    if args.collective_diff:
+        base_path, cur_path = args.collective_diff
+        try:
+            base = sharding.load_contract(base_path)
+            cur = sharding.load_contract(cur_path)
+        except (OSError, ValueError, KeyError) as e:
+            print("mxlint: cannot read collective contract: %s" % e,
+                  file=sys.stderr)
+            return 2
+        diags.extend(d for d in sharding.diff_contract(base, cur)
+                     if d.rule not in ignore)
+
     if not paths and not args.graph and not run_retrace \
-            and not args.changed:
+            and not args.changed and not args.collective_diff:
         _build_parser().print_usage()
         return 2
 

@@ -45,14 +45,22 @@ def distributed_init(coordinator_address=None, num_processes=None,
 
 
 # ----------------------------------------------------------------------
-# Host-side collectives over the coordination service.
+# Host-side collectives.
 #
 # On TPU pods the backend is multi-process and XLA collectives ride
-# ICI/DCN (use those inside jit).  On backends without cross-process
-# support (CPU jaxlib without gloo -- this image), the coordination
-# service's key-value store still spans all processes, so host-side
-# reduction goes through it -- structurally the reference's ps-lite
-# server path: workers push values, every worker pulls and reduces.
+# ICI/DCN (use those inside jit).  On CPU, ``distributed_init`` wires
+# gloo collectives BEFORE backend creation, so the backend world is
+# multi-process there too and ``host_allreduce``/``host_broadcast``
+# take the same ``process_allgather`` path a pod takes -- exercised
+# in-suite by tests/test_distributed.py::
+# test_two_process_backend_collectives_gloo.  Only when the backend
+# failed to come up multi-process (a jaxlib without gloo, or a backend
+# initialized before distributed_init) does the coordination service's
+# key-value store carry the reduction -- structurally the reference's
+# ps-lite server path: workers push values, every worker pulls and
+# reduces.  That fallback funnels O(N*P) bytes through the coordinator
+# and warns once (_warn_kv_fallback); it is a test-environment escape
+# hatch, never the pod path.
 # ----------------------------------------------------------------------
 
 _seq = [0]

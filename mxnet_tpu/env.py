@@ -181,6 +181,26 @@ _VARS = [
            "interpreter exit (and by mx.profiling.save_reports()); "
            "'mxprof report'/'mxprof diff' consume them.  Unset: "
            "nothing auto-persists; save_reports(dir) still works."),
+    EnvVar("MXNET_TPU_SHARD_CHECK", bool, False,
+           "'1' arms the sharding sanitizer's compiled layer "
+           "(mxnet_tpu.analysis.sharding): every compiled executable "
+           "is registered (via the mx.profiling capture surface, which "
+           "this flag also enables) so analysis.sharding."
+           "collective_contract()/save_contract() can extract per-"
+           "executable GSPMD collective counts/bytes, and CI's "
+           "shardlint stage can diff them against the committed "
+           "ci/sharding_baseline.json -- failing, with the executable "
+           "and collective kind named, when a mismatched PartitionSpec "
+           "turns into resharding all-gathers."),
+    EnvVar("MXNET_TPU_TRANSFER_GUARD", str, "",
+           "When set, applied to jax's transfer guard at import "
+           "(jax.config jax_transfer_guard): one of allow | log | "
+           "disallow | log_explicit | disallow_explicit.  'disallow' "
+           "makes IMPLICIT host<->device transfers inside the step "
+           "(a Python scalar leaking into dispatch, an un-placed index "
+           "array) raise instead of silently stalling the pipeline; "
+           "explicit device_put/staging keeps working.  Scoped "
+           "version: analysis.sharding.transfer_guard(mode)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

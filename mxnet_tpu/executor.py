@@ -178,7 +178,13 @@ class Executor:
                                     for o in outs)
                     (grads,) = vjp(tuple(cts))
                     return outs, aux_up, grads
-                self._train_jit = jax.jit(_train_step)
+                # no donation by design: the legacy forward/backward
+                # protocol re-calls this executable with the SAME
+                # diff/nondiff buffers (backward(out_grads=...) recompute,
+                # arg_dict stays bound across steps) -- donating them
+                # would hand XLA buffers the executor still owns.  The
+                # donated single-dispatch step is parallel.TrainStep.
+                self._train_jit = jax.jit(_train_step)  # mxlint: disable=undonated-train-state
             # first call = trace + XLA compile; time it as the compile
             # event (later calls hit the executable cache)
             t0 = time.perf_counter() if first and _telemetry._ENABLED \

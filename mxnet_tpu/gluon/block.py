@@ -577,7 +577,11 @@ class HybridBlock(Block):
             outs, aux = fn2(pvals, ivals, rng_key)
             return outs, aux
 
-        entry.fwd_eval = jax.jit(eval_fn)
+        # no donation by design: pvals are the Parameter._data buffers
+        # and the forward returns activations, not updated params -- the
+        # inputs must survive the call (the donated whole-step program
+        # is parallel.TrainStep, which rebinds its outputs)
+        entry.fwd_eval = jax.jit(eval_fn)  # mxlint: disable=undonated-train-state
 
         def fwd_vjp(diff, nondiff, ivals, rng_key):
             def inner(d, i):
@@ -586,7 +590,9 @@ class HybridBlock(Block):
                 return fn2(merged, i, rng_key)
             return jax.vjp(inner, diff, ivals)
 
-        entry.fwd_vjp = jax.jit(fwd_vjp)
+        # same: diff/nondiff stay bound to Parameters across fwd+bwd (and
+        # retain_graph backward may pull the residuals twice)
+        entry.fwd_vjp = jax.jit(fwd_vjp)  # mxlint: disable=undonated-train-state
         entry.bwd = jax.jit(lambda vjp, cts: vjp(cts))
         entry._nondiff_names = nondiff_names
         return entry

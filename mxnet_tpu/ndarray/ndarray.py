@@ -882,11 +882,16 @@ def invoke(op: Op, tensor_args, kwargs, out=None):
             result = _wrap_outputs(op, raw, None, None, params)
 
     if _profiling._ENABLED and jfn is not None and \
-            not any(isinstance(d, bulk.LazyData) or _is_traced(d)
-                    for d in pdatas):
+            not any(_is_traced(d) for d in pdatas):
         # lazy cost capture (mx.profiling): a dict insert keyed on the
         # eager-jit cache sig; lower+compile+parse happens at report
-        # time, never here
+        # time, never here.  LazyData operands are fine -- they carry
+        # aval shape/dtype and the store abstracts everything to
+        # ShapeDtypeStructs on registration; excluding them made
+        # capture depend on whether the dispatch rode the bulk queue,
+        # which varies with process-global cache warmth (a test-order
+        # flake: a warm FullyConnected cache dropped the second layer's
+        # report)
         cargs = ((dyn_vals, key) + tuple(pdatas)) if op.stateful_rng \
             else ((dyn_vals,) + tuple(pdatas))
         _profiling.capture_jit("eager:%s" % op.name, jfn, cargs,

@@ -40,6 +40,20 @@ def _replicated(mesh):
     return NamedSharding(mesh, P())
 
 
+def _feed_scalar(val, dtype, sharding=None):
+    """Per-step host scalar feed (step counter, scheduled lr/wd,
+    rescale) as an EXPLICIT device transfer, landed replicated on the
+    mesh when one is given.  ``jnp.asarray`` would bind a
+    convert_element_type on the Python value -- an IMPLICIT transfer
+    that ``transfer_guard("disallow")`` rejects -- and an unplaced feed
+    would be resharded device-to-device at dispatch; the guard must
+    stay armable over the steady-state step loop so only genuine leaks
+    raise (docs/sharding.md)."""
+    x = np.asarray(val, dtype)
+    return jax.device_put(x, sharding) if sharding is not None \
+        else jax.device_put(x)
+
+
 def _batch_sharding(mesh, ndim, batch_axis=0, axis_name="dp"):
     spec = [None] * ndim
     spec[batch_axis] = axis_name
@@ -422,18 +436,19 @@ class TrainStep:
         num_update_at_start = max(opt.num_update, t_start)
         saved_num_update = opt.num_update
         opt.num_update = num_update_at_start
-        lrs = jnp.asarray([opt._get_lr(i) for i in idxs], jnp.float32)
-        wds = jnp.asarray([opt._get_wd(i) for i in idxs], jnp.float32)
+        rep = _replicated(self._mesh) if self._mesh is not None else None
+        lrs = _feed_scalar([opt._get_lr(i) for i in idxs], np.float32, rep)
+        wds = _feed_scalar([opt._get_wd(i) for i in idxs], np.float32, rep)
         opt.num_update = saved_num_update
         for i in idxs:
             opt._index_update_count[i] = \
                 opt._index_update_count.get(i, opt.begin_num_update) + k
             opt.num_update = max(opt._index_update_count[i], opt.num_update)
-        t = jnp.asarray(t_start, jnp.int32)
+        t = _feed_scalar(t_start, np.int32, rep)
         bs = batch_size if batch_size is not None \
             else data.shape[self._batch_axis + 1]
-        rescale = jnp.asarray(tr._scale / bs, jnp.float32)
-        loss_scale = jnp.asarray(1.0, jnp.float32)
+        rescale = _feed_scalar(tr._scale / bs, np.float32, rep)
+        loss_scale = _feed_scalar(1.0, np.float32, rep)
         upd = tr._updater
         pvals = {n: pmap[n]._data._data for n in pnames}
         svals = {i: jax.tree_util.tree_map(
@@ -569,16 +584,17 @@ class TrainStep:
             opt._index_update_count[i] = \
                 opt._index_update_count.get(i, opt.begin_num_update) + 1
             opt.num_update = max(opt._index_update_count[i], opt.num_update)
-        t = jnp.asarray(opt._index_update_count[idxs[0]] if idxs else
-                        opt.num_update, jnp.int32)
-        lrs = jnp.asarray([opt._get_lr(i) for i in idxs], jnp.float32)
-        wds = jnp.asarray([opt._get_wd(i) for i in idxs], jnp.float32)
+        rep = _replicated(self._mesh) if self._mesh is not None else None
+        t = _feed_scalar(opt._index_update_count[idxs[0]] if idxs else
+                         opt.num_update, np.int32, rep)
+        lrs = _feed_scalar([opt._get_lr(i) for i in idxs], np.float32, rep)
+        wds = _feed_scalar([opt._get_wd(i) for i in idxs], np.float32, rep)
         bs = batch_size if batch_size is not None \
             else data.shape[self._batch_axis]
         scaler = getattr(tr, "_amp_loss_scaler", None)
         ls = scaler.loss_scale if scaler is not None else 1.0
-        rescale = jnp.asarray(tr._scale / bs / ls, jnp.float32)
-        loss_scale = jnp.asarray(ls, jnp.float32)
+        rescale = _feed_scalar(tr._scale / bs / ls, np.float32, rep)
+        loss_scale = _feed_scalar(ls, np.float32, rep)
 
         upd = tr._updater
         pvals = {n: pmap[n]._data._data for n in pnames}

@@ -18,7 +18,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec",
-           "local_devices", "default_mesh"]
+           "local_devices", "default_mesh", "AXIS_ROLES"]
+
+# Canonical mesh-axis vocabulary.  Axis names are arbitrary strings to
+# XLA, but the parallel layers, the docs, and the sharding sanitizer
+# (mxnet_tpu.analysis.sharding, rule ``mesh-axis-unknown``) all speak
+# these five roles; a PartitionSpec naming an axis outside this table
+# AND outside every Mesh/make_mesh construction in the linted tree is
+# flagged, because XLA silently replicates over unknown axes instead of
+# sharding.  Project-specific axes are declared simply by building a
+# mesh with them.
+AXIS_ROLES = OrderedDict([
+    ("dp", "data parallel: batch dim sharded, gradients psum over ICI"),
+    ("tp", "tensor (model) parallel: Megatron column/row weight splits"),
+    ("pp", "pipeline parallel: stacked stage params, ppermute ring"),
+    ("sp", "sequence/context parallel: ring-attention KV rotation"),
+    ("ep", "expert parallel: stacked MoE experts, all-to-all dispatch"),
+])
 
 
 def local_devices(platform=None):

@@ -165,7 +165,9 @@ def export_compiled(block, path, input_shapes, dtype="float32"):
               for n, v in pvals.items()}
     # multi-platform artifact: the same .mxa serves on TPU and CPU
     # (edge deploys rarely run where they were built)
-    exported = jexport.export(jax.jit(fn),
+    # no donation: this is the AOT inference export -- the serving
+    # runtime feeds the same weight buffers into every request
+    exported = jexport.export(jax.jit(fn),  # mxlint: disable=undonated-train-state
                               platforms=("cpu", "tpu"))(pspecs, *specs)
     hlo = exported.serialize()
 

@@ -165,6 +165,10 @@ def report_for(obj, label=None, step_time_s=None, items_per_step=None):
 
 
 # env arming (read directly, matching the package's != "0" convention;
-# the typed registry view lives in mxnet_tpu/env.py)
-if os.environ.get("MXNET_TPU_PROFILING", "0") != "0":
+# the typed registry view lives in mxnet_tpu/env.py).
+# MXNET_TPU_SHARD_CHECK rides the same capture surface: the sharding
+# sanitizer's collective-contract audit (analysis/sharding.py) reads
+# registered executables from this store, so arming it arms capture.
+if os.environ.get("MXNET_TPU_PROFILING", "0") != "0" or \
+        os.environ.get("MXNET_TPU_SHARD_CHECK", "0") != "0":
     enable()

@@ -28,6 +28,9 @@ _pending = {}      # key -> spec dict (label, fn, args, kind, meta)
 _reports = {}      # key -> CostReport dict
 _failed = set()    # keys whose lowering failed (don't retry forever)
 _steps = {}        # label -> {"count","total_s","min_s","max_s","items"}
+_live = {}         # key -> (label, fn, args); survives materialization so
+#                    analysis.sharding's collective auditor can re-lower
+#                    (cache-hit) any registered executable at audit time
 
 
 def register(key, label, fn, args, kind="jit", **meta):
@@ -50,6 +53,17 @@ def register(key, label, fn, args, kind="jit", **meta):
         if key not in _pending and key not in _reports:
             _pending[key] = {"label": label, "fn": fn, "args": specs,
                              "kind": kind, "meta": meta}
+            _live[key] = (label, fn, specs)
+
+
+def executables():
+    """Snapshot of every registered executable as ``(label, fn,
+    abstract_args)`` tuples, in registration order.  Unlike
+    ``_pending``, entries persist after report materialization -- the
+    sharding sanitizer's collective-contract audit lowers them again
+    (hitting jax's executable cache) whenever it runs."""
+    with _lock:
+        return list(_live.values())
 
 
 def record_step(label, seconds, items=None):
@@ -183,3 +197,4 @@ def clear():
         _reports.clear()
         _failed.clear()
         _steps.clear()
+        _live.clear()

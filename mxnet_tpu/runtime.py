@@ -50,6 +50,10 @@ def _detect():
         # compiled-step cost accounting (mx.profiling): LIVE enable
         # state, same contract as the TELEMETRY row
         "PROFILING": _profiling_enabled(),
+        # sharding sanitizer compiled layer (analysis.sharding):
+        # whether MXNET_TPU_SHARD_CHECK armed collective-contract
+        # capture for this run
+        "SHARD_CHECK": _shard_check_enabled(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -67,6 +71,14 @@ def _tsan_enabled():
 def _profiling_enabled():
     from . import profiling
     return profiling.enabled()
+
+
+def _shard_check_enabled():
+    # env-read directly (the sharding module's shard_check_enabled()
+    # reads the same variable); importing mxnet_tpu.analysis here would
+    # drag the whole lint stack into feature probing
+    import os
+    return os.environ.get("MXNET_TPU_SHARD_CHECK", "0") != "0"
 
 
 def _try_import(mod):

@@ -222,6 +222,44 @@ print("TRAINER_WORKER_OK rank=%d loss %.4f -> %.4f" % (rank, first, last))
 """
 
 
+_GLOO_WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")   # see _WORKER's comment
+import numpy as np
+import mxnet_tpu as mx
+
+assert mx.distributed_init() is True
+from mxnet_tpu import distributed as dist
+
+# THE POD BRANCH, for real (ISSUE 7 satellite / VERDICT weak-4): with
+# gloo CPU collectives wired by distributed_init, the BACKEND world is
+# multi-process -- jax.process_count() matches the launcher world --
+# so host_allreduce/host_broadcast take the process_allgather /
+# broadcast_one_to_all path a TPU pod takes, NOT the O(N*P)
+# coordination-service KV fallback.
+assert jax.process_count() == 2, \
+    "backend world is %d, not 2: the gloo collectives did not come up" \
+    % jax.process_count()
+nproc, rank = dist.world()
+assert nproc == 2
+
+out = dist.host_allreduce(np.ones((4,), np.float32) * (rank + 1))
+np.testing.assert_allclose(np.asarray(out), np.full(4, 3.0))
+mean = dist.host_allreduce(np.ones((2,), np.float32) * (rank + 1),
+                           average=True)
+np.testing.assert_allclose(np.asarray(mean), np.full(2, 1.5))
+bc = dist.host_broadcast(np.full((3,), float(rank), np.float32))
+np.testing.assert_allclose(np.asarray(bc), np.zeros(3))
+
+# proof the fallback never ran: its one-shot warning latch is untouched
+assert dist._KV_FALLBACK_WARNED[0] is False, \
+    "host collectives fell back to the coordination-service KV path"
+print("GLOO_WORKER_OK rank=%d" % rank)
+"""
+
+
 def _launch(script_path, n, env):
     # coordinator startup can race the free-port probe on a busy
     # machine; retry once before calling it a failure
@@ -278,6 +316,23 @@ def test_two_process_gluon_trainer_dist_sync(tmp_path):
     out = _launch(script, 2, env)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert out.stdout.count("TRAINER_WORKER_OK") == 2
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TPU_SKIP_DIST") == "1",
+                    reason="dist tests disabled")
+def test_two_process_backend_collectives_gloo(tmp_path):
+    """The real `process_allgather` branch of distributed.host_allreduce
+    runs in-suite: gloo CPU collectives make the backend world
+    multi-process (jax.process_count() == launcher world), and the
+    KV-fallback warning latch proves the coordinator-funnel path was
+    never taken (ISSUE 7 satellite; was dead code per VERDICT weak-4)."""
+    script = tmp_path / "gloo_worker.py"
+    script.write_text(_GLOO_WORKER)
+    env = {**os.environ, "PYTHONPATH": REPO + os.pathsep +
+           os.environ.get("PYTHONPATH", "")}
+    out = _launch(script, 2, env)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert out.stdout.count("GLOO_WORKER_OK") == 2
 
 
 def test_horovod_single_process_api():
