@@ -405,6 +405,141 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
     return med, mfu, [round(w, 1) for w in wins]
 
 
+def bench_resnet50_lars(batch_size=512, k=10, dtype="bfloat16", reps=3):
+    """BASELINE config 5: bf16 AMP + LARS large-batch ResNet-50 --
+    the large-batch scaling recipe (layer-wise trust ratios keep SGD
+    stable at batch sizes where plain momentum diverges), measured on
+    the compiled K-step loop like the headline config.  Returns
+    (median img/s, mfu_or_None, per-window img/s list)."""
+    import contextlib
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import TrainStep
+
+    ctx = _ctx()
+    net = resnet50_v1()
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    # the trace-safe fused LARS (opt.create('lars') is pinned to the
+    # in-graph impl by test); skip_list keeps bias/gamma/beta on the
+    # plain momentum path as the reference does
+    trainer = gluon.Trainer(net.collect_params(), "lars",
+                            {"learning_rate": 0.1, "momentum": 0.9,
+                             "eta": 0.001}, kvstore=None)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer,
+                     mesh=None)
+    x = mx.nd.random.normal(shape=(k, batch_size, 3, 224, 224), ctx=ctx)
+    y = mx.nd.random.randint(0, 1000, shape=(k, batch_size),
+                             ctx=ctx).astype("float32")
+    amp_ctx = amp.scope(dtype) if dtype != "float32" \
+        else contextlib.nullcontext()
+    with amp_ctx:
+        step.run_steps(x, y)
+        float(step.run_steps(x, y).asnumpy()[-1])
+        wins = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = step.run_steps(x, y)
+            float(out.asnumpy()[-1])
+            wins.append(batch_size * k / (time.perf_counter() - t0))
+        step(x[0], y[0])
+        ca = step.cost_analysis()
+    med = statistics.median(wins)
+    dt = batch_size / med
+    mfu = None
+    peak = _peak_flops()
+    if ca and ca.get("flops") and peak:
+        mfu = round(ca["flops"] / dt / peak, 4)
+    _persist_cost_report("resnet50_lars_bf16", step, step_time_s=dt,
+                         items_per_step=batch_size)
+    return med, mfu, [round(w, 1) for w in wins]
+
+
+def bench_serving(offered_qps=(100, 400, 1600), duration_s=2.0,
+                  clients=8, buckets=(1, 2, 4, 8, 16), max_wait_ms=3.0):
+    """Serving-tier latency-vs-QPS curve (ISSUE 8 bench contract).
+
+    A LeNet servable behind the PRODUCT serving path
+    (``mx.serving.ModelRegistry``: AOT per-bucket executables + dynamic
+    batcher) takes open-loop traffic from ``clients`` threads at each
+    offered rate for ``duration_s``; per level the curve records
+    achieved QPS, p50/p95/p99 latency, mean batch occupancy (from the
+    ``serving.*`` telemetry counters), and shed count -- the knee where
+    p99 lifts off IS the capacity number a capacity planner needs.
+    """
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    net = _lenet_net()
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    x0 = mx.nd.array(np.zeros((1, 1, 28, 28), np.float32))
+    net(x0)
+    reg = mx.serving.ModelRegistry(compile_cache=False)
+    servable = reg.register("lenet", block=net, input_shape=(1, 28, 28),
+                            buckets=buckets, max_wait_ms=max_wait_ms,
+                            max_queue=1024)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    sample = np.random.RandomState(0) \
+        .rand(1, 28, 28).astype(np.float32)
+    curve = []
+    try:
+        for rate in offered_qps:
+            telemetry.reset("serving.")
+            latencies = []          # list.append is GIL-atomic
+            shed = [0]
+            interval = clients / float(rate)
+
+            def client():
+                t_end = time.perf_counter() + duration_s
+                while time.perf_counter() < t_end:
+                    t0 = time.perf_counter()
+                    try:
+                        servable.infer(sample, timeout=2.0)
+                        latencies.append(time.perf_counter() - t0)
+                    except Exception:
+                        shed[0] += 1
+                    pace = interval - (time.perf_counter() - t0)
+                    if pace > 0:
+                        # open-loop rate pacing, not state polling: the
+                        # sleep IS the offered-QPS control variable
+                        time.sleep(pace)  # mxlint: disable=sleep-poll
+
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t_start
+            lats = sorted(latencies)
+
+            def pct(q):
+                return round(1e3 * lats[min(len(lats) - 1,
+                                            int(q * len(lats)))], 3) \
+                    if lats else None
+            batches = telemetry.counter("serving.batches").value
+            responses = telemetry.counter("serving.responses").value
+            curve.append({
+                "offered_qps": rate,
+                "qps": round(len(lats) / wall, 1) if wall > 0 else None,
+                "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+                "p99_ms": pct(0.99),
+                "mean_occupancy": round(responses / batches, 3)
+                if batches else None,
+                "shed": shed[0] + telemetry.counter("serving.shed").value,
+            })
+    finally:
+        reg.shutdown(drain=True)
+        if not was_enabled:
+            telemetry.disable()
+    return curve
+
+
 def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
                     dtype="float32", use_flash=None, iters=20,
                     windows=1):
@@ -762,6 +897,41 @@ def main():
                       if headline else None}))
 
     # -- garnish (budget-gated; order = value per second) -------------
+    # BASELINE config 5: bf16 AMP + LARS large-batch (the last named
+    # BASELINE config without a bench line)
+    if _budget_ok("resnet50_imagenet_train_bf16_lars_largebatch", 300):
+        lars_out = {}
+
+        def _run_lars():
+            med, mfu, wins = bench_resnet50_lars(
+                512 if on_tpu else rn_bs, k=10 if on_tpu else 2,
+                dtype="bfloat16" if on_tpu else "float32",
+                reps=3 if on_tpu else 1)
+            lars_out["mfu"], lars_out["wins"] = mfu, wins
+            return med
+        _emit_with_retry(
+            "resnet50_imagenet_train_bf16_lars_largebatch", _run_lars,
+            attempts=1, unit="img/s",
+            extra={"batch_size": 512 if on_tpu else rn_bs,
+                   "optimizer": "lars"},
+            extra_fn=lambda: {"mfu": lars_out.get("mfu"),
+                              "windows": lars_out.get("wins"),
+                              **_cost_extra("resnet50_lars_bf16")})
+
+    # serving tier: latency-vs-QPS curve (ISSUE 8 bench contract)
+    if _budget_ok("serving_latency_qps", 120):
+        try:
+            curve = bench_serving(
+                offered_qps=(100, 400, 1600) if on_tpu else (50, 200),
+                duration_s=2.0 if on_tpu else 1.0,
+                clients=8 if on_tpu else 4)
+            print(json.dumps({"metric": "serving_latency_qps",
+                              "curve": curve, "unit": "qps/ms",
+                              "vs_baseline": None}))
+        except Exception as e:
+            print(json.dumps({"metric": "serving_latency_qps",
+                              "error": str(e)[:200]}))
+
     if _budget_ok("lenet_mnist_train", 120):
         _emit_with_retry("lenet_mnist_train",
                          lambda: bench_lenet(lenet_bs), attempts=1,

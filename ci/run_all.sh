@@ -18,6 +18,12 @@
 #                report must show non-empty step + category sections
 #                and mxprof diff of the run against itself must report
 #                zero drift (the regression-attribution contract)
+#   serving -> register a LeNet servable, fire concurrent requests
+#              from threads; gates: mean batch occupancy > 1 (dynamic
+#              batching is real), zero dropped responses after a
+#              graceful drain, per-request numerics vs the direct
+#              forward, and a non-empty `serving` section (ordered
+#              p50<=p99 percentiles) from the summarize CLI
 #   shardlint -> sharding sanitizer gates (docs/sharding.md): the
 #                full-tree static pass (mesh axes, shard_map arity,
 #                donation audit, implicit reshard), then a LeNet
@@ -36,7 +42,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint serving bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -256,7 +262,8 @@ EOF
     # fixture the watchdog must catch with a both-stacks report
     JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
         python -m pytest tests/test_sync.py tests/test_dataio.py \
-        tests/test_checkpoint.py tests/test_telemetry.py -q
+        tests/test_checkpoint.py tests/test_telemetry.py \
+        tests/test_serving.py -q
 }
 
 run_profiling() {
@@ -381,6 +388,87 @@ EOF
     python -m mxnet_tpu.analysis --collective-diff \
         ci/sharding_baseline.json "$sdir/current.json" --json
     rm -rf "$sdir"
+}
+
+run_serving() {
+    log "serving: concurrent-load smoke (dynamic batching + graceful drain)"
+    svjsonl=$(mktemp /tmp/mxtpu_serving_ci.XXXXXX.jsonl)
+    svcache=$(mktemp -d /tmp/mxtpu_serving_cache.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 \
+        MXNET_TPU_TELEMETRY_JSONL="$svjsonl" \
+        MXNET_TPU_SERVING_CACHE_DIR="$svcache" python - <<'EOF'
+import threading
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry
+
+# a LeNet servable, registered from a Gluon block (buckets warmed at
+# registration: no request below pays a first-compile)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Conv2D(8, kernel_size=5, activation="relu"),
+        gluon.nn.MaxPool2D(2, 2),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(); net.hybridize()
+net(mx.nd.array(np.zeros((1, 1, 28, 28), np.float32)))
+
+reg = mx.serving.ModelRegistry()
+s = reg.register("lenet", block=net, input_shape=(1, 28, 28),
+                 buckets=(1, 2, 4, 8), max_wait_ms=50, max_queue=256)
+
+# concurrent requests from threads: the dynamic batcher must assemble
+# real micro-batches (mean occupancy > 1), and the graceful drain must
+# lose NO in-flight response
+n_threads, per_thread = 4, 8
+results = [[None] * per_thread for _ in range(n_threads)]
+barrier = threading.Barrier(n_threads)
+sample = np.random.RandomState(0).rand(1, 28, 28).astype(np.float32)
+
+def client(tid):
+    barrier.wait()
+    futs = [s.submit(sample, timeout=30) for _ in range(per_thread)]
+    for i, f in enumerate(futs):
+        results[tid][i] = f.result(timeout=30)
+
+threads = [threading.Thread(target=client, args=(t,), daemon=True)
+           for t in range(n_threads)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+reg.shutdown(drain=True)          # graceful drain
+
+dropped = sum(1 for row in results for r in row if r is None)
+assert dropped == 0, "%d responses dropped after graceful drain" % dropped
+want = net(mx.nd.array(sample[None])).asnumpy()[0]
+for row in results:
+    for r in row:
+        np.testing.assert_allclose(r, want, rtol=1e-4, atol=1e-4)
+batches = telemetry.counter("serving.batches").value
+responses = telemetry.counter("serving.responses").value
+occ = responses / batches
+assert occ > 1, "mean batch occupancy %.2f (no dynamic batching)" % occ
+telemetry.flush()
+print("serving smoke ok: %d responses in %d batches (occupancy %.2f)"
+      % (responses, batches, occ))
+EOF
+    # gate: the summarize CLI must report a non-empty serving section
+    python -m mxnet_tpu.telemetry summarize "$svjsonl" --json > "$svjsonl.agg"
+    python - "$svjsonl.agg" <<'EOF'
+import json, sys
+agg = json.load(open(sys.argv[1]))
+sv = agg["serving"]
+assert sv["requests"] >= 32, sv
+assert sv["responses"] == sv["requests"], sv
+assert sv["batches"] > 0 and sv["mean_occupancy"] > 1, sv
+assert sv["shed"] == 0 and sv["timeouts"] == 0, sv
+assert sv["latency_p50_s"] is not None and sv["latency_p99_s"] is not None, sv
+assert sv["latency_p50_s"] <= sv["latency_p99_s"], sv
+print("serving gate ok: %d requests, occupancy %.2f, p99 %.1fms"
+      % (sv["requests"], sv["mean_occupancy"], 1e3 * sv["latency_p99_s"]))
+EOF
+    rm -rf "$svjsonl" "$svjsonl.agg" "$svcache"
 }
 
 run_bench() {

@@ -143,6 +143,7 @@ from . import numpy as np
 from . import numpy_extension as npx
 from . import predictor
 from .predictor import Predictor, CompiledPredictor
+from . import serving
 from . import visualization as viz
 visualization = viz
 from . import onnx

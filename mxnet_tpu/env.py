@@ -201,6 +201,40 @@ _VARS = [
            "array) raise instead of silently stalling the pipeline; "
            "explicit device_put/staging keeps working.  Scoped "
            "version: analysis.sharding.transfer_guard(mode)."),
+    EnvVar("MXNET_TPU_SERVING_BUCKETS", str, "1,2,4,8,16,32",
+           "Default padded batch buckets (comma-separated ascending "
+           "batch sizes) for mx.serving servables: a micro-batch of n "
+           "requests pads to the smallest bucket >= n, and every "
+           "bucket's executable is AOT-compiled and warmed at "
+           "registration.  Per-servable override: "
+           "ModelRegistry.register(buckets=...)."),
+    EnvVar("MXNET_TPU_SERVING_MAX_WAIT_MS", float, 5.0,
+           "Micro-batch assembly deadline (milliseconds) for the "
+           "mx.serving dynamic batcher: a batch dispatches as soon as "
+           "the largest bucket fills OR the oldest queued request has "
+           "waited this long.  Lower = tighter tail latency, higher = "
+           "better occupancy.  Per-servable override: "
+           "ModelRegistry.register(max_wait_ms=...)."),
+    EnvVar("MXNET_TPU_SERVING_QUEUE", int, 256,
+           "Bounded request-queue depth per mx.serving servable.  A "
+           "submit against a full queue raises ServingQueueFull "
+           "(counted in serving.shed) instead of growing latency "
+           "without bound -- the load-shedding/backpressure contract.  "
+           "Per-servable override: ModelRegistry.register("
+           "max_queue=...)."),
+    EnvVar("MXNET_TPU_SERVING_CACHE_DIR", str,
+           "~/.cache/mxnet_tpu/serving",
+           "Directory of the persistent serving compile cache: "
+           "per-bucket servable programs serialized via jax.export, "
+           "keyed on the normalized-StableHLO fingerprint, so a new "
+           "serving process warms registration from disk.  Disable "
+           "per-registry with ModelRegistry(compile_cache=False)."),
+    EnvVar("MXNET_TPU_SERVING_PREDICTOR_CACHE", int, 8,
+           "LRU bound on mx.Predictor's per-input-shape jit cache: at "
+           "most this many compiled shape classes stay resident; the "
+           "least-recently-used program is dropped beyond it (counted "
+           "in serving.compile_evictions).  Per-predictor override: "
+           "Predictor(jit_cache_size=...)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -458,7 +458,37 @@ ONNX2MX_OP = {
 }
 
 
-def _onnx_pads(attrs, nsp):
+def _onnx_pads(attrs, nsp, kernel=None, strides=None, dilations=None):
+    """Symmetric per-axis pads from ``pads`` or ``auto_pad``.
+
+    Third-party exporters (tf2onnx, some torch eras) emit ``auto_pad``
+    instead of explicit ``pads``; SAME_* resolves without the input
+    shape only when the padded total is even per axis, which holds for
+    the ubiquitous odd-kernel/stride-1 convs -- anything else is
+    rejected loudly rather than imported wrong.
+    """
+    auto = attrs.get("auto_pad", "NOTSET")
+    if isinstance(auto, bytes):
+        auto = auto.decode()
+    if auto in ("SAME_UPPER", "SAME_LOWER"):
+        if attrs.get("pads"):
+            raise MXNetError("onnx import: both pads and auto_pad set")
+        kernel = list(kernel or [])
+        strides = list(strides or [1] * nsp)
+        dilations = list(dilations or [1] * nsp)
+        out = []
+        for k, s, d in zip(kernel, strides, dilations):
+            if s != 1:
+                raise MXNetError(
+                    "onnx import: auto_pad=%s with stride %d needs the "
+                    "input shape; re-export with explicit pads" % (auto, s))
+            total = d * (k - 1)
+            if total % 2:
+                raise MXNetError(
+                    "onnx import: auto_pad=%s is asymmetric for "
+                    "even-kernel axis (kernel %d)" % (auto, k))
+            out.append(total // 2)
+        return out
     pads = attrs.get("pads")
     if not pads:
         return [0] * nsp
@@ -509,12 +539,18 @@ class _Importer:
 
             if op in ("Conv", "ConvTranspose"):
                 w = self.inits.get(ins[1])
+                # kernel_shape is optional in the spec: third-party
+                # graphs routinely rely on the weight's trailing dims
                 kernel = a.get("kernel_shape") or list(w.shape[2:])
                 nsp = len(kernel)
+                stride = a.get("strides", [1] * nsp)
+                dilate = a.get("dilations", [1] * nsp)
                 params = {"kernel": tuple(kernel),
-                          "stride": tuple(a.get("strides", [1] * nsp)),
-                          "dilate": tuple(a.get("dilations", [1] * nsp)),
-                          "pad": tuple(_onnx_pads(a, nsp)),
+                          "stride": tuple(stride),
+                          "dilate": tuple(dilate),
+                          "pad": tuple(_onnx_pads(a, nsp, kernel=kernel,
+                                                  strides=stride,
+                                                  dilations=dilate)),
                           "num_group": int(a.get("group", 1)),
                           "no_bias": len(ins) < 3}
                 if op == "Conv":
@@ -568,15 +604,21 @@ class _Importer:
             elif op in ("MaxPool", "AveragePool"):
                 kernel = a["kernel_shape"]
                 nsp = len(kernel)
+                stride = a.get("strides", [1] * nsp)
                 params = {"kernel": tuple(kernel),
-                          "stride": tuple(a.get("strides", [1] * nsp)),
-                          "pad": tuple(_onnx_pads(a, nsp)),
+                          "stride": tuple(stride),
+                          "pad": tuple(_onnx_pads(a, nsp, kernel=kernel,
+                                                  strides=stride)),
                           "pool_type": "max" if op == "MaxPool" else "avg",
                           "pooling_convention":
                           "full" if a.get("ceil_mode") else "valid"}
                 if op == "AveragePool":
+                    # the ONNX spec default is 0 (exclude padding) --
+                    # our exporter always writes the attr explicitly,
+                    # so honoring the spec default only changes
+                    # third-party graphs, where it is what they meant
                     params["count_include_pad"] = \
-                        bool(a.get("count_include_pad", 1))
+                        bool(a.get("count_include_pad", 0))
                 res = _make_node("Pooling", [self.sym_of(ins[0])], params,
                                  name=nm)
             elif op in ("GlobalMaxPool", "GlobalAveragePool"):
@@ -590,8 +632,31 @@ class _Importer:
                     raise MXNetError("onnx import: Flatten axis != 1")
                 res = _make_node("Flatten", [self.sym_of(ins[0])], {},
                                  name=nm)
+            elif op == "Constant":
+                # a Constant node IS an initializer wearing node syntax
+                # (the dominant third-party idiom for Reshape shapes)
+                val = a.get("value")
+                if val is None and "value_float" in a:
+                    val = np.asarray(a["value_float"], np.float32)
+                if val is None and "value_int" in a:
+                    val = np.asarray(a["value_int"], np.int64)
+                if val is None and "value_ints" in a:
+                    val = np.asarray(a["value_ints"], np.int64)
+                if val is None:
+                    raise MXNetError("onnx import: Constant node %r has "
+                                     "no supported value attr" % nm)
+                self.inits[out] = np.asarray(val)
+                continue
             elif op == "Reshape":
-                shape = [int(x) for x in self.const_of(ins[1])]
+                if len(ins) > 1:
+                    shape = [int(x) for x in self.const_of(ins[1])]
+                else:
+                    # opset<5 idiom (still emitted by some exporters):
+                    # the target shape rides as an attribute
+                    shape = [int(x) for x in a.get("shape", ())]
+                    if not shape:
+                        raise MXNetError("onnx import: Reshape without "
+                                         "shape input or attr")
                 res = _make_node("Reshape", [self.sym_of(ins[0])],
                                  {"shape": tuple(shape)}, name=nm)
             elif op == "Transpose":
@@ -640,10 +705,44 @@ class _Importer:
                 axes = a.get("axes")
                 if axes is None:
                     axes = [int(x) for x in self.const_of(ins[1])]
-                if len(axes) != 1:
-                    raise MXNetError("onnx import: multi-axis Unsqueeze")
-                res = _make_node("expand_dims", [self.sym_of(ins[0])],
-                                 {"axis": int(axes[0])}, name=nm)
+                if any(ax < 0 for ax in axes) and len(axes) > 1:
+                    raise MXNetError("onnx import: negative multi-axis "
+                                     "Unsqueeze")
+                res = self.sym_of(ins[0])
+                # multi-axis unsqueeze = chained expand_dims, ascending
+                # so earlier insertions don't shift later axes
+                for i, ax in enumerate(sorted(int(x) for x in axes)):
+                    res = _make_node("expand_dims", [res],
+                                     {"axis": ax},
+                                     name=nm if i == len(axes) - 1
+                                     else "%s_ax%d" % (nm, ax))
+            elif op == "Squeeze":
+                axes = a.get("axes")
+                if axes is None and len(ins) > 1:
+                    axes = [int(x) for x in self.const_of(ins[1])]
+                params = {} if axes is None \
+                    else {"axis": tuple(int(x) for x in axes)}
+                res = _make_node("squeeze", [self.sym_of(ins[0])],
+                                 params, name=nm)
+            elif op == "ReduceMean":
+                # ResNet-style third-party graphs spell global average
+                # pooling as ReduceMean over the spatial axes
+                axes = a.get("axes")
+                if axes is None and len(ins) > 1:
+                    axes = [int(x) for x in self.const_of(ins[1])]
+                if list(axes or []) != [2, 3]:
+                    raise MXNetError(
+                        "onnx import: ReduceMean only supported over "
+                        "spatial axes [2, 3] (got %r)" % (axes,))
+                pooled = _make_node("Pooling", [self.sym_of(ins[0])],
+                                    {"global_pool": True,
+                                     "pool_type": "avg"},
+                                    name=nm + "_gap"
+                                    if not a.get("keepdims", 1) else nm)
+                if a.get("keepdims", 1):
+                    res = pooled
+                else:
+                    res = _make_node("Flatten", [pooled], {}, name=nm)
             elif op == "Dropout":
                 res = self.sym_of(ins[0])
             elif op in ONNX2MX_OP:

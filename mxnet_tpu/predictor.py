@@ -28,11 +28,20 @@ from .ndarray import NDArray
 
 
 class Predictor:
-    """Reference: the C predict API object (``MXPredCreate``)."""
+    """Reference: the C predict API object (``MXPredCreate``).
+
+    One jitted program is compiled per input-shape *class*; the
+    programs live in a bounded LRU (``jit_cache_size``, default
+    ``MXNET_TPU_SERVING_PREDICTOR_CACHE``) so a long-lived serving
+    process fed adversarial shape diversity cannot grow compiled-
+    executable memory without bound -- the least-recently-used shape
+    class is dropped (and recompiles if it returns), counted by the
+    ``serving.compile_evictions`` telemetry counter.
+    """
 
     def __init__(self, symbol_file, param_file=None, ctx=None,
-                 input_shapes=None):
-        import jax
+                 input_shapes=None, jit_cache_size=None):
+        from collections import OrderedDict
         from . import symbol as sym_mod
         from .symbol.symbol import _eval_symbol
 
@@ -59,6 +68,11 @@ class Predictor:
         self._input_shapes = dict(input_shapes or {})
         self._inputs = {}
         self._outputs = None
+        if jit_cache_size is None:
+            from . import env as _env
+            jit_cache_size = _env.get("MXNET_TPU_SERVING_PREDICTOR_CACHE")
+        self._jit_cache_size = max(1, int(jit_cache_size))
+        self._jit_cache = OrderedDict()   # shape key -> jitted program
 
         def pure(feed_vals):
             class _W:
@@ -70,7 +84,30 @@ class Predictor:
             outs = _eval_symbol(self._sym, feed)
             return tuple(o._data for o in outs)
 
-        self._jit = jax.jit(pure)
+        self._pure = pure
+
+    def _jit_for(self, feed):
+        """The jitted program for this input-shape class, LRU-bounded.
+        A FRESH ``jax.jit`` wrapper per shape class means evicting the
+        entry releases its compiled executable (one shared wrapper
+        would keep every shape's program alive in jax's own cache)."""
+        import jax
+        key = tuple(sorted((name, tuple(v._data.shape),
+                            str(v._data.dtype))
+                           for name, v in self._inputs.items()))
+        cache = self._jit_cache
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._pure)
+            cache[key] = fn
+            if len(cache) > self._jit_cache_size:
+                cache.popitem(last=False)
+                from . import telemetry as _telemetry
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.serving_evict()
+        else:
+            cache.move_to_end(key)
+        return fn
 
     def set_input(self, name, arr):
         """Reference: ``MXPredSetInput``."""
@@ -93,7 +130,7 @@ class Predictor:
                        if n not in feed]
         if missing_aux:
             feed.update(self._default_aux(missing_aux))
-        self._outputs = [NDArray(o) for o in self._jit(feed)]
+        self._outputs = [NDArray(o) for o in self._jit_for(feed)(feed)]
         return self._outputs
 
     def _default_aux(self, names):

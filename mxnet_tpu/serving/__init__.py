@@ -1,0 +1,48 @@
+"""Serving tier: compiled, dynamically-batched inference (ISSUE 8).
+
+The north star names "heavy traffic from millions of users"; this
+subsystem is the user-facing half -- it composes the existing pillars
+into an inference engine:
+
+- **model registry** (``registry.py``): multi-tenant name -> servable
+  store loading from checkpoint manifests (PR 3), ``symbol+params``,
+  or ONNX (including third-party protobufs);
+- **executor pool** (``executor.py``): one AOT-compiled executable per
+  padded batch bucket, warmed at registration (no request pays a
+  first-compile), behind a persistent on-disk compile cache keyed on
+  the PR-6 normalized-HLO fingerprint (``cache.py``);
+- **dynamic batcher** (``batcher.py``): a ``sync``-disciplined bounded
+  request queue assembling micro-batches under a ``max_wait`` deadline,
+  padding to the nearest bucket, dispatching one compiled call and
+  scattering responses -- with per-request timeouts, queue-full
+  load-shedding, and graceful drain on shutdown;
+- **SLO telemetry**: ``serving.*`` instruments (request latency with
+  p50/p95/p99, QPS, batch occupancy, queue depth, shed/timeout counts)
+  through ``mx.telemetry``, summarized by the CLI's ``serving``
+  section; ``bench.py::bench_serving`` emits the latency-vs-QPS curve.
+
+::
+
+    reg = mx.serving.ModelRegistry()
+    reg.register("resnet", onnx="resnet50.onnx",
+                 input_shape=(3, 224, 224))
+    y = reg.infer("resnet", img)           # batched with other callers
+    reg.shutdown(drain=True)
+
+Tuning knobs (``docs/serving.md``): ``MXNET_TPU_SERVING_BUCKETS``,
+``MXNET_TPU_SERVING_MAX_WAIT_MS``, ``MXNET_TPU_SERVING_QUEUE``,
+``MXNET_TPU_SERVING_CACHE_DIR``.
+"""
+from __future__ import annotations
+
+from .batcher import (DynamicBatcher, RequestTimeout, ServableClosed,
+                      ServingQueueFull)
+from .cache import CompileCache, stablehlo_fingerprint
+from .executor import BucketExecutorPool
+from .registry import ModelRegistry, Servable
+
+__all__ = [
+    "ModelRegistry", "Servable", "DynamicBatcher", "BucketExecutorPool",
+    "CompileCache", "stablehlo_fingerprint",
+    "ServingQueueFull", "RequestTimeout", "ServableClosed",
+]

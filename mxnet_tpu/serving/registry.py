@@ -1,0 +1,306 @@
+"""Multi-tenant model registry: sources -> servable handles.
+
+A :class:`Servable` is one deployed model: a pure forward function +
+device-resident weights, an AOT-compiled per-bucket executor pool
+(warmed at registration), and a dynamic batcher with its own worker
+thread and bounded queue.  The :class:`ModelRegistry` owns many of them
+by name -- the multi-tenant surface a serving process exposes.
+
+Model sources (all land in the same ``fn(params, x) -> outs`` shape):
+
+- **Gluon block** (``block=``): ``HybridBlock.functionalize`` --
+  the same pure-function extraction the compiled trainer and the
+  ``.mxa`` edge export use.
+- **symbol+params** (``symbol=`` / ``params=``): a ``-symbol.json``
+  graph (path or Symbol) evaluated through the symbol executor, with
+  the reference's ``arg:``/``aux:`` key prefixes accepted.
+- **ONNX** (``onnx=``): ``mx.onnx.import_model`` -- including
+  third-party protobufs, not just our own exports.
+- **checkpoint** (``checkpoint=`` + ``block=``): params restored from a
+  PR-3 manifest-verified :class:`~mxnet_tpu.checkpoint.CheckpointManager`
+  step (the newest intact step by default) into the block, then served
+  as a block source.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .batcher import DynamicBatcher
+from .cache import CompileCache
+from .executor import BucketExecutorPool
+
+__all__ = ["ModelRegistry", "Servable"]
+
+
+def _default_buckets():
+    from .. import env as _env
+    spec = _env.get("MXNET_TPU_SERVING_BUCKETS")
+    try:
+        buckets = tuple(int(tok) for tok in str(spec).split(",") if tok)
+    except ValueError as e:
+        raise MXNetError("MXNET_TPU_SERVING_BUCKETS=%r is not a "
+                         "comma-separated int list" % (spec,)) from e
+    return buckets
+
+
+def _strip_prefixes(params):
+    return {(k.split(":", 1)[1] if ":" in k else k): v
+            for k, v in params.items()}
+
+
+def _device_value(v):
+    """Any array-ish (NDArray / numpy / jax) -> jax array."""
+    import jax.numpy as jnp
+    data = getattr(v, "_data", v)
+    return jnp.asarray(np.asarray(data) if not hasattr(data, "dtype")
+                       else data)
+
+
+class Servable:
+    """One deployed model: executor pool + dynamic batcher."""
+
+    def __init__(self, name, pool, batcher, source):
+        self.name = name
+        self.source = source
+        self._pool = pool
+        self._batcher = batcher
+
+    # -- client surface -------------------------------------------------
+    def submit(self, x, timeout=None):
+        """Queue one sample; returns a ``concurrent.futures.Future``."""
+        return self._batcher.submit(x, timeout=timeout)
+
+    def infer(self, x, timeout=None):
+        """Blocking single-sample inference: submit + wait.  The
+        ``timeout`` bounds the whole round trip (queue wait included)."""
+        fut = self.submit(x, timeout=timeout)
+        return fut.result(timeout=timeout)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def buckets(self):
+        return self._pool.buckets
+
+    @property
+    def input_shape(self):
+        return self._pool.input_shape
+
+    @property
+    def dtype(self):
+        return self._pool.dtype
+
+    def fingerprint(self, bucket):
+        return self._pool.fingerprint(bucket)
+
+    def queue_depth(self):
+        return self._batcher.queue_depth()
+
+    @property
+    def closed(self):
+        return self._batcher.closed
+
+    def close(self, drain=True):
+        self._batcher.close(drain=drain)
+
+    def __repr__(self):
+        return "Servable(%r, source=%r, buckets=%r, input=%r)" % (
+            self.name, self.source, self.buckets, self.input_shape)
+
+
+class ModelRegistry:
+    """Name -> Servable store; the multi-tenant serving surface.
+
+    ::
+
+        reg = mx.serving.ModelRegistry()
+        reg.register("lenet", block=net, input_shape=(1, 28, 28))
+        y = reg.infer("lenet", x)          # dynamically batched
+        reg.shutdown(drain=True)
+    """
+
+    def __init__(self, cache_dir=None, compile_cache=True):
+        from .. import sync as _sync
+        self._lock = _sync.Lock(name="serving.registry")
+        self._servables = {}
+        self._cache = CompileCache(cache_dir) if compile_cache else None
+
+    # -- registration ---------------------------------------------------
+    def register(self, name, block=None, symbol=None, params=None,
+                 onnx=None, checkpoint=None, step=None, input_shape=None,
+                 dtype="float32", input_name=None, buckets=None,
+                 max_wait_ms=None, max_queue=None, warmup=True):
+        """Load a model from one source into a warm servable handle.
+
+        Exactly one of ``block``, ``symbol``, ``onnx`` must be given
+        (``checkpoint`` composes with ``block``).  ``input_shape`` is
+        the per-sample shape (no batch dim) and is required for every
+        source.  Registration compiles and warms every bucket, so no
+        request pays a first-compile; re-registering a name drains and
+        replaces the previous servable.
+        """
+        if input_shape is None:
+            raise MXNetError("serving.register needs input_shape "
+                             "(per-sample, no batch dim)")
+        sources = [s is not None for s in (block, symbol, onnx)]
+        if checkpoint is not None and block is None:
+            raise MXNetError("checkpoint= needs block= for the "
+                             "architecture (a manifest stores params)")
+        if sum(sources) != 1:
+            raise MXNetError("serving.register needs exactly one of "
+                             "block= / symbol= / onnx=")
+        if checkpoint is not None:
+            self._restore_checkpoint(block, checkpoint, step)
+            source = "checkpoint"
+        elif block is not None:
+            source = "block"
+        elif onnx is not None:
+            source = "onnx"
+        else:
+            source = "symbol"
+        if block is not None:
+            fn, pvals = self._from_block(block, input_shape, dtype)
+        else:
+            if onnx is not None:
+                from ..onnx import import_model
+                sym, arg_params, aux_params = import_model(onnx)
+                pdict = {}
+                pdict.update(arg_params)
+                pdict.update(aux_params)
+            else:
+                sym, pdict = self._load_symbol(symbol, params)
+            fn, pvals = self._from_symbol(sym, pdict, input_name)
+
+        buckets = tuple(buckets) if buckets else _default_buckets()
+        pool = BucketExecutorPool(fn, pvals, input_shape, dtype, buckets,
+                                  cache=self._cache, label=name)
+        if warmup:
+            pool.warmup()
+        batcher = DynamicBatcher(pool, label=name, max_wait_ms=max_wait_ms,
+                                 max_queue=max_queue)
+        servable = Servable(name, pool, batcher, source)
+        with self._lock:
+            old = self._servables.get(name)
+            self._servables[name] = servable
+        if old is not None:
+            old.close(drain=True)
+        if _telemetry._ENABLED:
+            _telemetry.hooks.serving_model(name, source, len(buckets))
+        return servable
+
+    @staticmethod
+    def _restore_checkpoint(block, checkpoint, step):
+        from ..checkpoint import CheckpointManager
+        mgr = checkpoint if isinstance(checkpoint, CheckpointManager) \
+            else CheckpointManager(checkpoint)
+        ckpt = mgr.restore_training(block, step=step)
+        if ckpt is None:
+            raise MXNetError("serving: no intact checkpoint under %r"
+                             % mgr.root)
+        return ckpt
+
+    @staticmethod
+    def _from_block(block, input_shape, dtype):
+        import jax
+        if not hasattr(block, "functionalize"):
+            raise MXNetError("serving: block= expects a HybridBlock")
+        if any(p._data is None for p in block._all_params()):
+            # materialize deferred params with one probe forward (the
+            # export_compiled idiom)
+            from .. import ndarray as nd
+            probe = nd.zeros((1,) + tuple(input_shape)).astype(dtype)
+            block(probe)
+        pure_fn, pnames, pmap = block.functionalize(training=False)
+        pvals = {n: pmap[n].data()._data for n in pnames}
+        key = jax.random.PRNGKey(0)
+
+        def fn(params, x):
+            outs, _aux = pure_fn(params, [x], key)
+            return tuple(outs)
+
+        return fn, pvals
+
+    @staticmethod
+    def _load_symbol(symbol, params):
+        from .. import ndarray as nd
+        from ..symbol import symbol as sym_mod
+        sym = sym_mod.load(symbol) if isinstance(symbol, str) else symbol
+        if isinstance(params, str):
+            params = nd.load(params)
+        return sym, _strip_prefixes(dict(params or {}))
+
+    @staticmethod
+    def _from_symbol(sym, params, input_name):
+        from ..symbol.symbol import _eval_symbol
+        arg_names = sym.list_arguments()
+        aux_names = set(sym.list_auxiliary_states())
+        inputs = [n for n in arg_names
+                  if n not in params and n not in aux_names]
+        if input_name is None:
+            if len(inputs) != 1:
+                raise MXNetError(
+                    "serving: graph has inputs %r; pass input_name= to "
+                    "pick the batched one (others must be in params)"
+                    % (inputs,))
+            input_name = inputs[0]
+        elif input_name not in arg_names:
+            raise MXNetError("serving: unknown input %r (arguments: %s)"
+                             % (input_name, arg_names))
+        missing = [n for n in aux_names if n not in params]
+        if missing:
+            raise MXNetError("serving: aux states %r missing from "
+                             "params" % (missing,))
+        pvals = {n: _device_value(v) for n, v in params.items()}
+
+        def fn(pv, x):
+            feed = dict(pv)
+            feed[input_name] = x
+            outs = _eval_symbol(sym, feed)
+            return tuple(o._data for o in outs)
+
+        return fn, pvals
+
+    # -- lookup / client ------------------------------------------------
+    def servable(self, name):
+        with self._lock:
+            s = self._servables.get(name)
+        if s is None:
+            raise MXNetError("serving: no servable %r (registered: %s)"
+                             % (name, self.names()))
+        return s
+
+    def names(self):
+        with self._lock:
+            return sorted(self._servables)
+
+    def submit(self, name, x, timeout=None):
+        return self.servable(name).submit(x, timeout=timeout)
+
+    def infer(self, name, x, timeout=None):
+        return self.servable(name).infer(x, timeout=timeout)
+
+    # -- lifecycle ------------------------------------------------------
+    def unregister(self, name, drain=True):
+        with self._lock:
+            s = self._servables.pop(name, None)
+        if s is None:
+            raise MXNetError("serving: no servable %r" % name)
+        s.close(drain=drain)
+
+    def shutdown(self, drain=True):
+        """Close every servable (draining by default) -- the graceful
+        process-shutdown path."""
+        with self._lock:
+            servables = list(self._servables.values())
+            self._servables.clear()
+        for s in servables:
+            s.close(drain=drain)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._servables
+
+    def __len__(self):
+        with self._lock:
+            return len(self._servables)

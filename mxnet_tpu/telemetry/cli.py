@@ -16,6 +16,24 @@ from .sinks import prom_text, summary_table
 
 __all__ = ["main", "summarize_file"]
 
+# Exact-percentile bound: past this many streamed samples per timer the
+# tail is dropped from the percentile pool (count/sum/min/max stay
+# exact) -- an offline summarizer must not grow with run length.
+_MAX_PCTL_SAMPLES = 200_000
+
+
+def _exact_percentiles(values):
+    """p50/p95/p99 (nearest-rank) from exact sample values."""
+    if not values:
+        return {}
+    values = sorted(values)
+    n = len(values)
+
+    def rank(q):
+        return values[min(n - 1, max(0, int(round(q * n)) - 1))]
+
+    return {"p50": rank(0.50), "p95": rank(0.95), "p99": rank(0.99)}
+
 
 def _build_parser():
     ap = argparse.ArgumentParser(
@@ -63,12 +81,20 @@ def summarize_file(path):
             if kind == "sample":
                 agg = sample_folds.setdefault(
                     name, {"count": 0, "sum": 0.0, "min": None,
-                           "max": None})
+                           "max": None, "values": [], "t_first": None,
+                           "t_last": None})
                 v = float(rec.get("value", 0.0))
                 agg["count"] += 1
                 agg["sum"] += v
                 agg["min"] = v if agg["min"] is None else min(agg["min"], v)
                 agg["max"] = v if agg["max"] is None else max(agg["max"], v)
+                if len(agg["values"]) < _MAX_PCTL_SAMPLES:
+                    agg["values"].append(v)
+                t = rec.get("t")
+                if isinstance(t, (int, float)):
+                    if agg["t_first"] is None:
+                        agg["t_first"] = t
+                    agg["t_last"] = t
             elif kind == "event":
                 agg = event_folds.setdefault(
                     name, {"count": 0, "last_payload": None})
@@ -82,18 +108,29 @@ def summarize_file(path):
                                     ("value", "count", "min", "max")}
             elif kind == "snapshot.timer":
                 timers[name] = {k: rec.get(k) for k in
-                                ("count", "sum", "min", "max", "mean")}
+                                ("count", "sum", "min", "max", "mean",
+                                 "p50", "p95", "p99")
+                                if rec.get(k) is not None}
             elif kind == "snapshot.event":
                 events[name] = {"count": rec.get("count", 0),
                                 "last_payload": rec.get("last_payload")}
             else:
                 skipped += 1
     # streamed folds fill in anything the final snapshot missed (e.g. a
-    # run killed before flush)
+    # run killed before flush) -- and, because they carry the exact
+    # sample values, they upgrade every snapshot timer's
+    # histogram-estimated percentiles to exact ones
     for name, agg in sample_folds.items():
+        pctl = _exact_percentiles(agg.pop("values"))
+        span = (agg.pop("t_last") or 0) - (agg.pop("t_first") or 0)
+        rate = (agg["count"] - 1) / span \
+            if span > 0 and agg["count"] > 1 else None
         if name not in timers:
             timers[name] = {**agg, "mean": (agg["sum"] / agg["count"])
                             if agg["count"] else None}
+        timers[name].update(pctl)
+        if rate is not None:
+            timers[name]["rate_per_sec"] = round(rate, 2)
     for name, agg in event_folds.items():
         if name not in events:
             events[name] = agg
@@ -146,8 +183,36 @@ def summarize_file(path):
             "overlap_frac": gauges.get("feed.overlap_frac",
                                        {}).get("value"),
         },
+        "serving": _serving_section(counters, timers),
     }
     return result
+
+
+def _serving_section(counters, timers):
+    """SLO rollup of the serving.* instruments (docs/serving.md)."""
+    requests = counters.get("serving.requests", 0)
+    batches = counters.get("serving.batches", 0)
+    responses = counters.get("serving.responses", 0)
+    lat = timers.get("serving.latency", {})
+    return {
+        "requests": requests,
+        "responses": responses,
+        "batches": batches,
+        "mean_occupancy": round(responses / batches, 3) if batches
+        else None,
+        "shed": counters.get("serving.shed", 0),
+        "timeouts": counters.get("serving.timeouts", 0),
+        "qps": lat.get("rate_per_sec"),
+        "latency_p50_s": lat.get("p50"),
+        "latency_p95_s": lat.get("p95"),
+        "latency_p99_s": lat.get("p99"),
+        "latency_mean_s": lat.get("mean"),
+        "compile_cache_hits": counters.get("serving.compile_cache_hits",
+                                           0),
+        "compile_cache_misses":
+        counters.get("serving.compile_cache_misses", 0),
+        "compile_evictions": counters.get("serving.compile_evictions", 0),
+    }
 
 
 def _to_snapshot(agg):
@@ -191,6 +256,21 @@ def _render_human(agg):
         lines.append("  input: %d batches, %.3fs waiting (mean %.1fms)"
                      % (da["batches"], da["wait_s"] or 0.0,
                         1e3 * (da["mean_wait_s"] or 0.0)))
+    sv = agg.get("serving", {})
+    if sv.get("requests"):
+        occ = sv.get("mean_occupancy")
+        lat = [("p%s" % p, sv.get("latency_p%s_s" % p))
+               for p in (50, 95, 99)]
+        lat_txt = " ".join("%s=%.1fms" % (k, 1e3 * v)
+                           for k, v in lat if v is not None)
+        lines.append(
+            "  serving: %d requests in %d batches%s, %d shed / %d "
+            "timed out%s%s"
+            % (sv["requests"], sv["batches"],
+               " (occupancy %.2f)" % occ if occ is not None else "",
+               sv["shed"], sv["timeouts"],
+               ", %.1f qps" % sv["qps"] if sv.get("qps") else "",
+               (", " + lat_txt) if lat_txt else ""))
     fd = agg.get("feed", {})
     if fd.get("batches"):
         lines.append(

@@ -165,10 +165,35 @@ class Timer(Instrument):
     def sum(self):
         return self._sum
 
+    def percentile(self, q):
+        """Histogram-estimated q-quantile (0 < q <= 1): the upper bound
+        of the bucket where the cumulative count crosses ``q * count``,
+        clamped into [min, max] so single-observation timers report the
+        observation itself rather than a bucket edge."""
+        with self._lock:
+            count = self._count
+            if not count:
+                return None
+            rank = q * count
+            acc = 0
+            est = self._max
+            for bound, n in zip(_TIMER_BUCKETS, self._buckets):
+                acc += n
+                if acc >= rank:
+                    est = bound
+                    break
+            return min(max(est, self._min), self._max)
+
+    def _percentiles(self):
+        return {"p50": self.percentile(0.50),
+                "p95": self.percentile(0.95),
+                "p99": self.percentile(0.99)}
+
     def snapshot(self):
         return {"kind": "timer", "name": self.name, "count": self._count,
                 "sum": self._sum, "min": self._min, "max": self._max,
                 "mean": (self._sum / self._count) if self._count else None,
+                **self._percentiles(),
                 "buckets": {("%g" % b): n for b, n in
                             zip(_TIMER_BUCKETS, self._buckets) if n}}
 

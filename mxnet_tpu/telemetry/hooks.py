@@ -78,6 +78,34 @@ profiling.capture      event   one per report, payload carries
 profiling.step_time    timer   per-dispatch step wall recorded by
                                TrainStep under MXNET_TPU_PROFILING=1
                                (feeds the roofline)
+serving.requests       counter requests accepted by serving submit()
+serving.responses      counter responses scattered from dispatched
+                               batches (mean batch occupancy =
+                               responses / batches)
+serving.batches        counter compiled batch dispatches
+serving.batch_occupancy gauge  requests in the last dispatched batch
+                               (>1 = dynamic batching is working)
+serving.queue_depth    gauge   request-queue depth at last submit
+serving.shed           counter submits rejected by a full queue
+                               (ServingQueueFull backpressure)
+serving.timeouts       counter requests expired while queued
+                               (RequestTimeout)
+serving.latency        timer   per-request round trip submit ->
+                               response (the SLO metric; p50/p95/p99
+                               in the summarize CLI)
+serving.dispatch_time  timer   compiled-call wall per batch
+serving.warmup_time    timer   per-servable registration warm-up
+                               (all buckets compiled + executed)
+serving.models         counter servables registered
+serving.compile_cache_hits
+                       counter bucket executables served from the
+                               persistent serving compile cache
+serving.compile_cache_misses
+                       counter bucket executables compiled fresh (and
+                               committed to the cache)
+serving.compile_evictions
+                       counter Predictor per-shape jit programs
+                               evicted by the LRU bound
 =====================  ======  =========================================
 """
 from __future__ import annotations
@@ -89,6 +117,9 @@ __all__ = [
     "checkpoint", "checkpoint_wait",
     "sync_contention", "sync_hold", "sync_watchdog", "sync_inversion",
     "profiling_capture", "profiling_step",
+    "serving_request", "serving_shed", "serving_timeout",
+    "serving_batch", "serving_latency", "serving_warmup",
+    "serving_model", "serving_compile_cache", "serving_evict",
 ]
 
 
@@ -242,3 +273,54 @@ def profiling_step(label, seconds):
     """One step wall time recorded for the roofline clock."""
     _registry().timer("profiling.step_time").observe(seconds,
                                                      label=label)
+
+
+def serving_request(model, queue_depth):
+    reg = _registry()
+    reg.counter("serving.requests").inc()
+    reg.gauge("serving.queue_depth").set(queue_depth)
+
+
+def serving_shed(model):
+    _registry().counter("serving.shed").inc()
+
+
+def serving_timeout(model):
+    _registry().counter("serving.timeouts").inc()
+
+
+def serving_batch(model, occupancy, bucket, seconds):
+    """One compiled batch dispatched: ``occupancy`` real requests
+    padded to ``bucket``."""
+    reg = _registry()
+    reg.counter("serving.batches").inc()
+    reg.counter("serving.responses").inc(int(occupancy))
+    reg.gauge("serving.batch_occupancy").set(occupancy)
+    reg.timer("serving.dispatch_time").observe(seconds, model=model,
+                                               bucket=bucket,
+                                               occupancy=occupancy)
+
+
+def serving_latency(seconds):
+    _registry().timer("serving.latency").observe(seconds)
+
+
+def serving_warmup(model, seconds, n_buckets):
+    _registry().timer("serving.warmup_time").observe(
+        seconds, model=model, buckets=n_buckets)
+
+
+def serving_model(model, source, n_buckets):
+    reg = _registry()
+    reg.counter("serving.models").inc()
+    reg.event("serving.register").emit(model=model, source=source,
+                                       buckets=n_buckets)
+
+
+def serving_compile_cache(hit):
+    _registry().counter("serving.compile_cache_hits" if hit
+                        else "serving.compile_cache_misses").inc()
+
+
+def serving_evict():
+    _registry().counter("serving.compile_evictions").inc()

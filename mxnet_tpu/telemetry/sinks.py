@@ -143,10 +143,13 @@ def summary_table(snapshot):
                           r["count"]))
         out.append("")
     if groups["timer"]:
-        header("timers", ("name", "count  mean  min  max  total"))
+        header("timers",
+               ("name", "count  mean  p50  p95  p99  min  max  total"))
         for r in groups["timer"]:
-            out.append("  %-44s %-6d %s  %s  %s  %s"
+            out.append("  %-44s %-6d %s  %s  %s  %s  %s  %s  %s"
                        % (r["name"], r["count"], _fmt_secs(r.get("mean")),
+                          _fmt_secs(r.get("p50")), _fmt_secs(r.get("p95")),
+                          _fmt_secs(r.get("p99")),
                           _fmt_secs(r.get("min")), _fmt_secs(r.get("max")),
                           _fmt_secs(r.get("sum"))))
         out.append("")

@@ -174,6 +174,149 @@ def test_onnx_package_interop(tmp_path):
     onnx.checker.check_model(model)
 
 
+def test_third_party_graph_idioms(tmp_path):
+    """ISSUE 8 satellite: import_model must read the idioms third-party
+    exporters emit that our own exporter never does -- Constant nodes
+    as initializers, auto_pad=SAME_UPPER without kernel_shape, the
+    opset default for count_include_pad, ReduceMean-as-global-pool,
+    Reshape shape ATTRS, and initializers duplicated as graph inputs --
+    and the result must load into SymbolBlock (the serving registry's
+    ONNX path)."""
+    from mxnet_tpu.gluon.block import SymbolBlock
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32) * 0.1
+    mean = rng.randn(4).astype(np.float32) * 0.1
+    var = rng.rand(4).astype(np.float32) + 0.5
+    Wfc = rng.randn(5, 4).astype(np.float32) * 0.1
+    bfc = rng.randn(5).astype(np.float32) * 0.1
+
+    nodes = [
+        # no kernel_shape (weight dims rule), auto_pad instead of pads
+        wire.make_node("Conv", ["data", "W"], ["c1"], "c1",
+                       {"auto_pad": "SAME_UPPER"}),
+        # spatial/training_mode attrs from older opsets are tolerated
+        wire.make_node("BatchNormalization",
+                       ["c1", "gamma", "beta", "mean", "var"],
+                       ["bn1"], "bn1",
+                       {"epsilon": 1e-5, "spatial": 1, "momentum": 0.9}),
+        wire.make_node("Relu", ["bn1"], ["r1"], "r1"),
+        wire.make_node("MaxPool", ["r1"], ["p1"], "p1",
+                       {"kernel_shape": [2, 2], "strides": [2, 2]}),
+        # torch spells global-average-pool as ReduceMean over [2, 3]
+        wire.make_node("ReduceMean", ["p1"], ["gap"], "gap",
+                       {"axes": [2, 3], "keepdims": 0}),
+        # Constant node feeding Reshape (the dominant shape idiom)
+        wire.make_node("Constant", [], ["shape_c"], "shape_c",
+                       {"value": np.asarray([0, -1], np.int64)}),
+        wire.make_node("Reshape", ["gap", "shape_c"], ["flat"], "flat"),
+        wire.make_node("Gemm", ["flat", "Wfc", "bfc"], ["out"], "out",
+                       {"alpha": 1.0, "beta": 1.0, "transB": 1}),
+    ]
+    weights = [("W", W), ("gamma", gamma), ("beta", beta),
+               ("mean", mean), ("var", var), ("Wfc", Wfc), ("bfc", bfc)]
+    inits = [wire.make_tensor(n, v) for n, v in weights]
+    inputs = [wire.make_value_info("data", wire.DT_FLOAT, (1, 3, 8, 8))]
+    # initializers ALSO listed as graph inputs (torch/tf idiom)
+    inputs += [wire.make_value_info(n, wire.DT_FLOAT, v.shape)
+               for n, v in weights]
+    outputs = [wire.make_value_info("out", wire.DT_FLOAT, ())]
+    model = wire.make_model(wire.make_graph(nodes, "tp", inputs,
+                                            outputs, inits))
+    path = str(tmp_path / "third_party.onnx")
+    with open(path, "wb") as f:
+        f.write(model)
+
+    sym, arg_params, aux_params = import_model(path)
+    assert set(aux_params) == {"mean", "var"}     # BN stats land as aux
+    x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    blk = SymbolBlock(sym, ["data"], {**arg_params, **aux_params})
+    got = blk(mx.nd.array(x)).asnumpy()
+
+    # independent numpy reference
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    c = np.zeros((2, 4, 8, 8), np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(8):
+                for j in range(8):
+                    c[n, f, i, j] = np.sum(xp[n, :, i:i + 3, j:j + 3]
+                                           * W[f])
+    bn = (gamma[None, :, None, None]
+          * (c - mean[None, :, None, None])
+          / np.sqrt(var[None, :, None, None] + 1e-5)
+          + beta[None, :, None, None])
+    r = np.maximum(bn, 0)
+    p = r.reshape(2, 4, 4, 2, 4, 2).max(axis=(3, 5))
+    want = p.mean(axis=(2, 3)) @ Wfc.T + bfc
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_third_party_attr_idioms(tmp_path):
+    """Reshape-shape-as-attr (opset<5), multi-axis Unsqueeze, Squeeze,
+    and the count_include_pad spec default (0 = exclude padding)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+
+    nodes = [
+        # padded avg pool WITHOUT count_include_pad: the spec default
+        # excludes the pad ring from the divisor
+        wire.make_node("AveragePool", ["data"], ["ap"], "ap",
+                       {"kernel_shape": [3, 3], "strides": [1, 1],
+                        "pads": [1, 1, 1, 1]}),
+        # legacy shape-as-attribute Reshape
+        wire.make_node("Reshape", ["ap"], ["rs"], "rs",
+                       {"shape": [1, 32]}),
+        # multi-axis Unsqueeze via attr
+        wire.make_node("Unsqueeze", ["rs"], ["un"], "un",
+                       {"axes": [0, 3]}),
+        wire.make_node("Squeeze", ["un"], ["out"], "out",
+                       {"axes": [0, 3]}),
+    ]
+    inputs = [wire.make_value_info("data", wire.DT_FLOAT, (1, 2, 4, 4))]
+    outputs = [wire.make_value_info("out", wire.DT_FLOAT, ())]
+    model = wire.make_model(wire.make_graph(nodes, "attrs", inputs,
+                                            outputs, []))
+    path = str(tmp_path / "attr_idioms.onnx")
+    with open(path, "wb") as f:
+        f.write(model)
+    sym, arg_params, aux_params = import_model(path)
+    got = _eval_sym(sym, arg_params, aux_params, data=x)
+
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    counts = np.pad(np.ones((1, 1, 4, 4), np.float32),
+                    ((0, 0), (0, 0), (1, 1), (1, 1)))
+    num = np.zeros((1, 2, 4, 4), np.float32)
+    den = np.zeros((1, 1, 4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            num[..., i, j] = xp[..., i:i + 3, j:j + 3].sum(axis=(-1, -2))
+            den[..., i, j] = counts[..., i:i + 3, j:j + 3].sum(
+                axis=(-1, -2))
+    want = (num / den).reshape(1, 32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_auto_pad_stride_rejected(tmp_path):
+    """SAME_* with stride > 1 needs the input shape; reject loudly."""
+    from mxnet_tpu.base import MXNetError
+    W = np.zeros((2, 1, 3, 3), np.float32)
+    nodes = [wire.make_node("Conv", ["data", "W"], ["c"], "c",
+                            {"auto_pad": "SAME_UPPER",
+                             "strides": [2, 2]})]
+    inputs = [wire.make_value_info("data", wire.DT_FLOAT, (1, 1, 8, 8))]
+    outputs = [wire.make_value_info("c", wire.DT_FLOAT, ())]
+    model = wire.make_model(wire.make_graph(
+        nodes, "g", inputs, outputs, [wire.make_tensor("W", W)]))
+    path = str(tmp_path / "autopad.onnx")
+    with open(path, "wb") as f:
+        f.write(model)
+    with pytest.raises(MXNetError):
+        import_model(path)
+
+
 def test_dot_export_rank_guard(tmp_path):
     """mx dot is tensordot(axes=1); ONNX MatMul diverges once the RHS
     has rank > 2, so such exports must be rejected, not silently wrong.

@@ -37,6 +37,43 @@ def test_predictor_from_export(tmp_path):
         pred.set_input("not_an_input", x)
 
 
+def test_predictor_jit_cache_lru_bound(tmp_path):
+    """ISSUE 8 satellite: the per-input-shape jit cache is LRU-bounded
+    (one compiled program per shape class cannot grow without bound);
+    evictions count into serving.compile_evictions and an evicted shape
+    still serves correctly on return (it just recompiles)."""
+    from mxnet_tpu import telemetry
+    net, x = _trained_net(tmp_path)
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    telemetry.enable()
+    telemetry.reset("serving.")
+    try:
+        pred = mx.Predictor(prefix + "-symbol.json",
+                            prefix + "-0000.params", jit_cache_size=2)
+        shapes = [(1, 3, 8, 8), (2, 3, 8, 8), (3, 3, 8, 8)]
+        wants = {}
+        for s in shapes:
+            xs = np.random.RandomState(s[0]).randn(*s).astype(np.float32)
+            wants[s] = (xs, net(mx.nd.array(xs)).asnumpy())
+        for s in shapes:
+            pred.forward(data=wants[s][0])
+        assert len(pred._jit_cache) == 2          # bounded
+        assert telemetry.counter("serving.compile_evictions").value == 1
+        # the evicted (oldest) shape still serves -- recompiled, correct
+        xs, want = wants[shapes[0]]
+        pred.forward(data=xs)
+        np.testing.assert_allclose(pred.get_output(0).asnumpy(), want,
+                                   rtol=1e-4, atol=1e-4)
+        assert telemetry.counter("serving.compile_evictions").value == 2
+        # hitting a cached shape moves it to MRU instead of evicting
+        pred.forward(data=xs)
+        assert telemetry.counter("serving.compile_evictions").value == 2
+    finally:
+        telemetry.reset("serving.")
+        telemetry.disable()
+
+
 def test_compiled_artifact_roundtrip(tmp_path):
     net, x = _trained_net(tmp_path)
     want = net(x).asnumpy()

@@ -1,0 +1,499 @@
+"""Serving tier tests (ISSUE 8): model registry sources, per-bucket AOT
+executor pool + persistent compile cache, dynamic batcher semantics
+(batching, padding, timeout, shedding, drain), and the serving.* SLO
+telemetry surface incl. the summarize CLI's percentile columns."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, serving, telemetry
+from mxnet_tpu.serving import (RequestTimeout, ServableClosed,
+                               ServingQueueFull)
+
+
+def _mlp(out=4):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(out))
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 8), np.float32)))
+    return net
+
+
+def _convnet():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, 3, padding=1, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(5))
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 3, 8, 8), np.float32)))
+    return net
+
+
+@pytest.fixture()
+def registry():
+    reg = serving.ModelRegistry(compile_cache=False)
+    yield reg
+    reg.shutdown(drain=True)
+
+
+@pytest.fixture()
+def counters():
+    telemetry.enable()
+    telemetry.reset("serving.")
+    yield telemetry
+    telemetry.reset("serving.")
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------
+# registry sources
+# ---------------------------------------------------------------------
+
+def test_register_block_numerics(registry):
+    net = _mlp()
+    s = registry.register("mlp", block=net, input_shape=(8,),
+                          buckets=(1, 2), max_wait_ms=1)
+    x = np.random.RandomState(0).rand(8).astype(np.float32)
+    want = net(mx.nd.array(x[None])).asnumpy()[0]
+    got = s.infer(x, timeout=10)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_register_symbol_params(registry, tmp_path):
+    net = _convnet()
+    x = np.random.RandomState(1).randn(3, 8, 8).astype(np.float32)
+    want = net(mx.nd.array(x[None])).asnumpy()[0]
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    s = registry.register("sym", symbol=prefix + "-symbol.json",
+                          params=prefix + "-0000.params",
+                          input_shape=(3, 8, 8), buckets=(1,),
+                          max_wait_ms=1)
+    np.testing.assert_allclose(s.infer(x, timeout=10), want,
+                               rtol=1e-4, atol=1e-4)
+    assert s.source == "symbol"
+
+
+def test_register_onnx(registry, tmp_path):
+    from mxnet_tpu.onnx import export_model
+    net = _convnet()
+    x = np.random.RandomState(2).randn(3, 8, 8).astype(np.float32)
+    want = net(mx.nd.array(x[None])).asnumpy()[0]
+    prefix = str(tmp_path / "m")
+    net.export(prefix)
+    onnx_file = str(tmp_path / "m.onnx")
+    export_model(prefix + "-symbol.json", prefix + "-0000.params",
+                 in_shapes=[(1, 3, 8, 8)], onnx_file_path=onnx_file)
+    s = registry.register("onnx", onnx=onnx_file, input_shape=(3, 8, 8),
+                          buckets=(1, 4), max_wait_ms=1)
+    np.testing.assert_allclose(s.infer(x, timeout=10), want,
+                               rtol=1e-4, atol=1e-4)
+    assert s.source == "onnx"
+
+
+def test_register_checkpoint_manifest(registry, tmp_path):
+    """The checkpoint source restores the newest INTACT manifest-
+    verified step (PR 3 discovery) before serving."""
+    net = _convnet()
+    x = np.random.RandomState(3).randn(3, 8, 8).astype(np.float32)
+    want = net(mx.nd.array(x[None])).asnumpy()[0]
+    mgr = mx.checkpoint.CheckpointManager(str(tmp_path / "ckpts"))
+    mgr.save_training(5, net)
+
+    fresh = _convnet()                      # different random params
+    assert not np.allclose(fresh(mx.nd.array(x[None])).asnumpy()[0],
+                           want, atol=1e-4)
+    s = registry.register("ckpt", block=fresh,
+                          checkpoint=str(tmp_path / "ckpts"),
+                          input_shape=(3, 8, 8), buckets=(1,),
+                          max_wait_ms=1)
+    np.testing.assert_allclose(s.infer(x, timeout=10), want,
+                               rtol=1e-4, atol=1e-4)
+    assert s.source == "checkpoint"
+
+
+def test_register_validation(registry):
+    net = _mlp()
+    with pytest.raises(mx.MXNetError):           # no input_shape
+        registry.register("a", block=net)
+    with pytest.raises(mx.MXNetError):           # no source
+        registry.register("a", input_shape=(8,))
+    with pytest.raises(mx.MXNetError):           # two sources
+        registry.register("a", block=net, onnx="x.onnx",
+                          input_shape=(8,))
+    with pytest.raises(mx.MXNetError):           # checkpoint needs block
+        registry.register("a", checkpoint="/nope", input_shape=(8,))
+    with pytest.raises(mx.MXNetError):
+        registry.servable("never-registered")
+
+
+def test_multi_tenant_registry(registry):
+    a, b = _mlp(out=3), _mlp(out=6)
+    registry.register("a", block=a, input_shape=(8,), buckets=(1, 2),
+                      max_wait_ms=1)
+    registry.register("b", block=b, input_shape=(8,), buckets=(1, 2),
+                      max_wait_ms=1)
+    assert registry.names() == ["a", "b"] and len(registry) == 2
+    x = np.random.RandomState(4).rand(8).astype(np.float32)
+    assert registry.infer("a", x, timeout=10).shape == (3,)
+    assert registry.infer("b", x, timeout=10).shape == (6,)
+    registry.unregister("a")
+    assert "a" not in registry and "b" in registry
+
+
+def test_multi_output_model(registry):
+    class TwoHead(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.a = gluon.nn.Dense(3)
+                self.b = gluon.nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            return self.a(x), self.b(x)
+
+    net = TwoHead()
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 8), np.float32)))
+    s = serving.ModelRegistry(compile_cache=False).register(
+        "two", block=net, input_shape=(8,), buckets=(1,), max_wait_ms=1)
+    try:
+        out = s.infer(np.ones(8, np.float32), timeout=10)
+        assert isinstance(out, tuple) and len(out) == 2
+        assert out[0].shape == (3,) and out[1].shape == (2,)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------
+# executor pool: buckets, warm-up, compile cache
+# ---------------------------------------------------------------------
+
+def test_warmup_compiles_every_bucket_no_request_compile(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1, 2, 4), max_wait_ms=1)
+    assert s._pool.compiled_buckets() == [1, 2, 4]
+
+    def boom(bucket):
+        raise AssertionError("request-path compile for bucket %d"
+                             % bucket)
+    s._pool._build = boom          # any post-warmup compile blows up
+    got = s.infer(np.ones(8, np.float32), timeout=10)
+    assert got.shape == (4,)
+
+
+def test_bucket_padding_matches_unpadded_numerics(registry):
+    """A 3-request micro-batch pads to bucket 4; the pad row must not
+    leak into the real rows' outputs."""
+    net = _mlp()
+    s = registry.register("mlp", block=net, input_shape=(8,),
+                          buckets=(4,), max_wait_ms=100, max_queue=16)
+    rng = np.random.RandomState(5)
+    xs = [rng.rand(8).astype(np.float32) for _ in range(3)]
+    futs = [s.submit(x, timeout=10) for x in xs]
+    for x, f in zip(xs, futs):
+        want = net(mx.nd.array(x[None])).asnumpy()[0]
+        np.testing.assert_allclose(f.result(timeout=10), want,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_oversize_and_wrong_shape_rejected(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1, 2), max_wait_ms=1)
+    with pytest.raises(mx.MXNetError):
+        s.submit(np.ones((2, 8), np.float32))    # batched request
+    with pytest.raises(mx.MXNetError):
+        s.submit(np.ones(9, np.float32))         # wrong sample shape
+    with pytest.raises(mx.MXNetError):
+        s._pool.bucket_for(3)                    # beyond largest bucket
+
+
+def test_compile_cache_roundtrip(tmp_path, counters):
+    """Second process-equivalent registration (fresh registry, same
+    cache dir) deserializes the committed artifacts -- hit counters
+    move and numerics hold."""
+    net = _mlp()
+    x = np.random.RandomState(6).rand(8).astype(np.float32)
+    want = net(mx.nd.array(x[None])).asnumpy()[0]
+    reg1 = serving.ModelRegistry(cache_dir=str(tmp_path))
+    reg1.register("mlp", block=net, input_shape=(8,), buckets=(1, 2),
+                  max_wait_ms=1)
+    reg1.shutdown()
+    misses = telemetry.counter("serving.compile_cache_misses").value
+    assert misses == 2                      # one per bucket
+
+    reg2 = serving.ModelRegistry(cache_dir=str(tmp_path))
+    s = reg2.register("mlp", block=net, input_shape=(8,),
+                      buckets=(1, 2), max_wait_ms=1)
+    try:
+        assert telemetry.counter("serving.compile_cache_hits").value == 2
+        np.testing.assert_allclose(s.infer(x, timeout=10), want,
+                                   rtol=1e-5, atol=1e-6)
+    finally:
+        reg2.shutdown()
+
+
+def test_compile_cache_corrupt_artifact_is_miss(tmp_path, counters):
+    import os
+    net = _mlp()
+    reg1 = serving.ModelRegistry(cache_dir=str(tmp_path))
+    reg1.register("mlp", block=net, input_shape=(8,), buckets=(1,),
+                  max_wait_ms=1)
+    reg1.shutdown()
+    (artifact,) = [f for f in os.listdir(tmp_path)
+                   if f.endswith(".mxe")]
+    with open(tmp_path / artifact, "wb") as f:
+        f.write(b"\x00garbage")
+    telemetry.reset("serving.")
+    reg2 = serving.ModelRegistry(cache_dir=str(tmp_path))
+    s = reg2.register("mlp", block=net, input_shape=(8,), buckets=(1,),
+                      max_wait_ms=1)
+    try:
+        assert telemetry.counter("serving.compile_cache_hits").value == 0
+        assert s.infer(np.ones(8, np.float32), timeout=10).shape == (4,)
+    finally:
+        reg2.shutdown()
+
+
+def test_stablehlo_fingerprint_normalizes_volatile_parts():
+    text1 = ('module @jit_fn1 attributes {x = 1} {\n'
+             '  %0 = stablehlo.add %a, %b : tensor<2xf32> loc(#loc3)\n'
+             '}\n#loc3 = loc("file.py":10:2)\n')
+    text2 = ('module @jit_other attributes {x = 1} {\n'
+             '  %0 = stablehlo.add %a, %b : tensor<2xf32> loc(#loc7)\n'
+             '}\n#loc7 = loc("elsewhere.py":99:1)\n')
+    text3 = text1.replace("2xf32", "4xf32")
+    fp = serving.stablehlo_fingerprint
+    assert fp(text1) == fp(text2)
+    assert fp(text1) != fp(text3)
+
+
+def test_servable_fingerprints_per_bucket(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1, 2), max_wait_ms=1)
+    f1, f2 = s.fingerprint(1), s.fingerprint(2)
+    assert f1 and f2 and f1 != f2
+
+
+# ---------------------------------------------------------------------
+# dynamic batcher semantics
+# ---------------------------------------------------------------------
+
+def test_concurrent_requests_batch_dynamically(registry, counters):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1, 2, 4, 8), max_wait_ms=100,
+                          max_queue=64)
+    n = 8
+    barrier = threading.Barrier(n)
+    outs = [None] * n
+
+    def client(i):
+        barrier.wait()
+        outs[i] = s.infer(np.full(8, i, np.float32), timeout=10)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(o is not None for o in outs)
+    batches = telemetry.counter("serving.batches").value
+    responses = telemetry.counter("serving.responses").value
+    assert responses == n
+    assert responses / batches > 1, "no dynamic batching happened"
+
+
+def test_per_request_timeout_sheds_queued_request(registry, counters):
+    """A request whose deadline passes while still queued resolves with
+    RequestTimeout and never occupies a batch slot."""
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(8,), max_wait_ms=500, max_queue=16)
+    fut = s.submit(np.ones(8, np.float32), timeout=0.02)
+    with pytest.raises(RequestTimeout):
+        fut.result(timeout=10)
+    assert telemetry.counter("serving.timeouts").value == 1
+    assert telemetry.counter("serving.batches").value == 0
+
+
+def test_queue_full_sheds_with_backpressure(registry, counters):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1,), max_wait_ms=1, max_queue=2)
+    gate = threading.Event()
+    started = threading.Event()
+    orig = s._pool.call
+
+    def slow(bucket, x):
+        started.set()
+        gate.wait(20)
+        return orig(bucket, x)
+
+    s._pool.call = slow
+    x = np.ones(8, np.float32)
+    first = s.submit(x, timeout=None)
+    assert started.wait(10)        # worker is busy inside dispatch
+    q1 = s.submit(x)               # queue: 1
+    q2 = s.submit(x)               # queue: 2 == max_queue
+    with pytest.raises(ServingQueueFull):
+        s.submit(x)                # shed
+    assert telemetry.counter("serving.shed").value == 1
+    gate.set()
+    for f in (first, q1, q2):      # backlogged requests still complete
+        assert f.result(timeout=20) is not None
+
+
+def test_graceful_drain_loses_no_responses(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(4,), max_wait_ms=2000, max_queue=64)
+    futs = [s.submit(np.full(8, i, np.float32)) for i in range(10)]
+    s.close(drain=True)            # returns after the queue is drained
+    for f in futs:
+        assert f.result(timeout=0.5) is not None
+    with pytest.raises(ServableClosed):
+        s.submit(np.ones(8, np.float32))
+
+
+def test_close_without_drain_resolves_pending_as_closed(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(4,), max_wait_ms=2000, max_queue=64)
+    futs = [s.submit(np.ones(8, np.float32)) for _ in range(3)]
+    s.close(drain=False)
+    resolved = 0
+    for f in futs:
+        try:
+            f.result(timeout=0.5)
+            resolved += 1
+        except ServableClosed:
+            resolved += 1
+    assert resolved == 3           # every future resolved, none dropped
+
+
+def test_reregister_replaces_and_drains_old(registry):
+    net1, net2 = _mlp(), _mlp()
+    registry.register("m", block=net1, input_shape=(8,), buckets=(1,),
+                      max_wait_ms=1)
+    old = registry.servable("m")
+    registry.register("m", block=net2, input_shape=(8,), buckets=(1,),
+                      max_wait_ms=1)
+    assert old.closed
+    x = np.random.RandomState(7).rand(8).astype(np.float32)
+    want = net2(mx.nd.array(x[None])).asnumpy()[0]
+    np.testing.assert_allclose(registry.infer("m", x, timeout=10), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dispatch_error_fails_requests_not_worker(registry):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1,), max_wait_ms=1, max_queue=8)
+
+    def boom(bucket, x):
+        raise RuntimeError("device fell over")
+
+    orig = s._pool.call
+    s._pool.call = boom
+    with pytest.raises(RuntimeError):
+        s.infer(np.ones(8, np.float32), timeout=10)
+    s._pool.call = orig            # worker survived; serving resumes
+    assert s.infer(np.ones(8, np.float32), timeout=10).shape == (4,)
+
+
+# ---------------------------------------------------------------------
+# SLO telemetry + summarize CLI
+# ---------------------------------------------------------------------
+
+def test_serving_telemetry_instruments(registry, counters):
+    s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                          buckets=(1, 2), max_wait_ms=1)
+    for _ in range(3):
+        s.infer(np.ones(8, np.float32), timeout=10)
+    reg = telemetry.registry()
+    assert reg.counter("serving.requests").value == 3
+    assert reg.counter("serving.responses").value == 3
+    assert reg.timer("serving.latency").count == 3
+    assert reg.timer("serving.dispatch_time").count >= 1
+    assert reg.counter("serving.models").value == 1
+    assert reg.timer("serving.warmup_time").count == 1
+    assert reg.gauge("serving.batch_occupancy").value >= 1
+
+
+def test_summarize_serving_section_and_percentiles(registry, counters,
+                                                   tmp_path):
+    from mxnet_tpu.telemetry import cli as tcli
+    path = str(tmp_path / "run.jsonl")
+    telemetry.attach_jsonl(path)
+    try:
+        s = registry.register("mlp", block=_mlp(), input_shape=(8,),
+                              buckets=(1, 2), max_wait_ms=1)
+        for _ in range(5):
+            s.infer(np.ones(8, np.float32), timeout=10)
+        telemetry.flush()
+    finally:
+        telemetry._jsonl_sink.close()
+    agg = tcli.summarize_file(path)
+    sv = agg["serving"]
+    assert sv["requests"] == 5 and sv["responses"] == 5
+    assert sv["mean_occupancy"] >= 1
+    assert sv["shed"] == 0 and sv["timeouts"] == 0
+    for k in ("latency_p50_s", "latency_p95_s", "latency_p99_s"):
+        assert sv[k] is not None and sv[k] > 0
+    assert sv["latency_p50_s"] <= sv["latency_p99_s"]
+    assert sv["qps"] is None or sv["qps"] > 0
+    # the human rendering carries the serving line + percentile columns
+    text = tcli._render_human(agg)
+    assert "serving:" in text and "p50" in text and "p99" in text
+    # machine shape is json-serializable end to end
+    json.dumps(agg)
+
+
+def test_timer_percentiles_live_snapshot():
+    from mxnet_tpu.telemetry.core import Registry
+    reg = Registry()
+    t = reg.timer("t")
+    for v in (0.001, 0.002, 0.004, 0.1):
+        t.observe(v)
+    snap = t.snapshot()
+    assert snap["p50"] is not None
+    assert snap["p50"] <= snap["p95"] <= snap["p99"]
+    assert snap["p99"] <= snap["max"]
+    assert t.percentile(0.5) >= snap["min"]
+    # empty timer: no percentiles, no crash
+    t2 = reg.timer("t2")
+    assert t2.percentile(0.5) is None
+    assert t2.snapshot()["p50"] is None
+
+
+def test_summary_table_has_percentile_columns():
+    from mxnet_tpu.telemetry.core import Registry
+    from mxnet_tpu.telemetry.sinks import summary_table
+    reg = Registry()
+    for v in (0.01, 0.02, 0.03):
+        reg.timer("lat").observe(v)
+    table = summary_table(reg.snapshot())
+    assert "p50" in table and "p95" in table and "p99" in table
+
+
+def test_queue_depth_and_idle_worker_under_tsan():
+    """The batcher's worker waits in bounded slices, so an idle
+    servable under MXNET_TPU_TSAN=1 never trips the untimed-wait
+    deadlock watchdog."""
+    from mxnet_tpu import sync
+    sync.enable(watchdog_s=60)
+    try:
+        reg = serving.ModelRegistry(compile_cache=False)
+        s = reg.register("mlp", block=_mlp(), input_shape=(8,),
+                         buckets=(1,), max_wait_ms=1)
+        time.sleep(0.3)            # idle under the sanitizer
+        assert s.queue_depth() == 0
+        assert s.infer(np.ones(8, np.float32), timeout=10) is not None
+        reg.shutdown(drain=True)
+    finally:
+        sync.disable()
+        sync.reset_state()
