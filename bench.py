@@ -456,6 +456,107 @@ def bench_resnet50_lars(batch_size=512, k=10, dtype="bfloat16", reps=3):
     return med, mfu, [round(w, 1) for w in wins]
 
 
+def bench_multichip_scaling(device_counts=(1, 2, 4, 8),
+                            batch_per_device=32, iters=6, warmup=2,
+                            devices=None):
+    """Device-count scaling line (ISSUE 9): the SAME convnet trains as
+    ONE compiled SPMD program (``parallel.TrainStep``) over a 1/2/4/8
+    device ``dp`` mesh at fixed per-device batch; each row reports
+    img/s, per-device parallel efficiency vs the 1-device run, and the
+    compiled step's IN-GRAPH collective kinds/bytes pulled from the
+    sharding sanitizer (``analysis.sharding.collective_profile``) --
+    the gradient all-reduce GSPMD inserted, not host kvstore traffic.
+    On CPU the virtual devices share one host's cores, so efficiency
+    documents the contention floor; on a pod the same line measures the
+    ICI. Returns the list of row dicts."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.analysis.sharding import collective_profile
+    from mxnet_tpu.parallel import TrainStep, make_mesh, shard_batch
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    rng = np.random.RandomState(0)
+    rows, base_img_s = [], None
+    for n in device_counts:
+        if n > len(devices):
+            rows.append({"n_devices": n,
+                         "skipped": "only %d devices" % len(devices)})
+            continue
+        mesh = make_mesh({"dp": n}, devices=devices[:n])
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1,
+                                activation="relu"),
+                gluon.nn.MaxPool2D(2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(10))
+        net.initialize(ctx=mx.cpu(), force_reinit=True)
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9},
+                                kvstore=None)
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         trainer, mesh=mesh)
+        batch = batch_per_device * n
+        x = shard_batch(rng.randn(batch, 3, 16, 16).astype(np.float32),
+                        mesh)
+        y = shard_batch(rng.randint(0, 10, batch).astype(np.float32),
+                        mesh)
+        for _ in range(warmup):
+            step(x, y)
+        float(np.asarray(step(x, y)._data))     # drain before the window
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(iters):
+            last = step(x, y)
+        float(np.asarray(last._data))
+        dt = time.perf_counter() - t0
+        img_s = batch * iters / dt
+        fn, args = step._last_call
+        prof = collective_profile(fn.lower(*args).compile().as_text())
+        row = {"n_devices": n,
+               "img_per_s": round(img_s, 1),
+               "per_device_img_per_s": round(img_s / n, 1),
+               "collectives": prof,
+               "collective_bytes": sum(rec["bytes"]
+                                       for rec in prof.values())}
+        if base_img_s is None:
+            base_img_s = img_s / n
+            row["efficiency"] = 1.0
+        else:
+            row["efficiency"] = round(img_s / n / base_img_s, 3)
+        rows.append(row)
+    return rows
+
+
+def _multichip_scaling_rows(device_counts=(1, 2, 4, 8), timeout=600):
+    """Run the scaling sweep in a fresh CPU subprocess with enough
+    virtual host devices (the calling process may own a single real
+    chip; the sweep needs a 1..8-device ladder and must not disturb
+    this process's backend)."""
+    import re
+    import subprocess
+    import sys
+    n_max = max(device_counts)
+    env = dict(_os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags +
+                        " --xla_force_host_platform_device_count=%d"
+                        % n_max).strip()
+    code = ("import sys, json; sys.path.insert(0, %r); import bench; "
+            "print(json.dumps(bench.bench_multichip_scaling(%r)))"
+            % (_os.path.dirname(_os.path.abspath(__file__)),
+               tuple(device_counts)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr[-500:])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_serving(offered_qps=(100, 400, 1600), duration_s=2.0,
                   clients=8, buckets=(1, 2, 4, 8, 16), max_wait_ms=3.0):
     """Serving-tier latency-vs-QPS curve (ISSUE 8 bench contract).
@@ -917,6 +1018,18 @@ def main():
             extra_fn=lambda: {"mfu": lars_out.get("mfu"),
                               "windows": lars_out.get("wins"),
                               **_cost_extra("resnet50_lars_bf16")})
+
+    # MULTICHIP scaling line (ISSUE 9 bench contract): 1/2/4/8-device
+    # SPMD train step, per-host efficiency + in-graph collective bytes
+    if _budget_ok("multichip_scaling", 240):
+        try:
+            rows = _multichip_scaling_rows()
+            print(json.dumps({"metric": "multichip_scaling",
+                              "unit": "img/s", "scaling": rows,
+                              "vs_baseline": None}))
+        except Exception as e:
+            print(json.dumps({"metric": "multichip_scaling",
+                              "error": str(e)[:200]}))
 
     # serving tier: latency-vs-QPS curve (ISSUE 8 bench contract)
     if _budget_ok("serving_latency_qps", 120):

@@ -24,6 +24,15 @@
 #              graceful drain, per-request numerics vs the direct
 #              forward, and a non-empty `serving` section (ordered
 #              p50<=p99 percentiles) from the summarize CLI
+#   spmd -> one-program multi-host gate (docs/distributed.md): a REAL
+#           2-process gloo smoke train through tools/launch.py -- the
+#           dist train step must be ONE compiled SPMD program whose
+#           steady-state steps run under transfer_guard("disallow"),
+#           kv push/pull byte counters must stay ZERO across steps
+#           (kvstore is a veneer; gradients all-reduce in-graph), and
+#           rank 0's collective contract must match the committed
+#           ci/sharding_baseline.json (the gradient all-reduce is
+#           blessed; anything else fails naming executable+kind)
 #   shardlint -> sharding sanitizer gates (docs/sharding.md): the
 #                full-tree static pass (mesh axes, shard_map arity,
 #                donation audit, implicit reshard), then a LeNet
@@ -42,7 +51,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint serving bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint spmd serving bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -264,6 +273,11 @@ EOF
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py \
         tests/test_serving.py -q
+    log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
+    # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
+    # paths (ISSUE 9) run with the lock sanitizer armed end to end
+    JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=120 \
+        python -m pytest tests/test_distributed.py -q -k "gloo or spmd"
 }
 
 run_profiling() {
@@ -388,6 +402,77 @@ EOF
     python -m mxnet_tpu.analysis --collective-diff \
         ci/sharding_baseline.json "$sdir/current.json" --json
     rm -rf "$sdir"
+}
+
+run_spmd() {
+    log "spmd: 2-proc gloo one-program smoke train (transfer guard + zero kv bytes)"
+    pdir=$(mktemp -d /tmp/mxtpu_spmd_ci.XXXXXX)
+    cat > "$pdir/spmd_worker.py" <<'EOF'
+import os, sys, re
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", "")).strip()   # one device per rank
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry
+from mxnet_tpu import distributed as dist
+from mxnet_tpu.analysis import sharding
+from mxnet_tpu.parallel import TrainStep, global_mesh
+
+outdir = sys.argv[1]
+assert mx.distributed_init() is True
+assert jax.process_count() == 2, jax.process_count()
+nproc, rank = dist.world()
+
+
+class SpmdSmokeNet(gluon.nn.HybridSequential):
+    """Named so the dist executable gets its own blessed baseline row."""
+
+
+net = SpmdSmokeNet()
+net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd",
+                   {"learning_rate": 0.05, "momentum": 0.9},
+                   kvstore="dist_sync")
+step = TrainStep(net, gluon.loss.L2Loss(), tr)   # auto global mesh
+assert step._mesh.shape["dp"] == 2
+
+rng = np.random.RandomState(100 + rank)
+w = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+x = rng.randn(8, 8).astype(np.float32)           # per-rank LOCAL batch
+y = (x @ w).astype(np.float32)
+l0 = float(np.asarray(step(x, y)._data))         # compile + init sync
+telemetry.reset("kvstore.")
+with sharding.transfer_guard("disallow"):        # steady state, guarded
+    for _ in range(8):
+        loss = step(x, y)
+    last = float(np.asarray(loss._data))
+assert last < l0, (l0, last)
+for verb in ("push", "pull", "pushpull", "bytes"):
+    assert telemetry.counter("kvstore." + verb).value == 0, \
+        "kv.%s moved host bytes on the hot path" % verb
+assert dist._KV_FALLBACK_WARNED[0] is False, "KV fallback latch warm"
+if rank == 0:
+    cur = sharding.save_contract(os.path.join(outdir, "current.json"))
+    kinds = cur["executables"]["train_step:SpmdSmokeNet"]
+    assert "all-reduce" in kinds, kinds
+dist.barrier("spmd_ci_done")
+print("SPMD_CI_OK rank=%d loss %.4f -> %.4f" % (rank, l0, last))
+EOF
+    JAX_PLATFORMS=cpu MXNET_TPU_SHARD_CHECK=1 MXNET_TPU_TELEMETRY=1 \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python tools/launch.py -n 2 python -u "$pdir/spmd_worker.py" "$pdir"
+    log "spmd: collective-baseline diff gate (rank 0's dist executable)"
+    # the gradient all-reduce is blessed in ci/sharding_baseline.json;
+    # an unblessed kind or a grown count exits 1 naming executable+kind
+    python -m mxnet_tpu.analysis --collective-diff \
+        ci/sharding_baseline.json "$pdir/current.json" --json
+    rm -rf "$pdir"
 }
 
 run_serving() {

@@ -217,8 +217,16 @@ def restore_sharded(dirpath, manifest, sharding=None):
             s = sharding(item, key, arr.shape) if callable(sharding) \
                 else sharding.get((item, key)) \
                 if isinstance(sharding, dict) else sharding
-            placed[key] = nd.NDArray(jax.device_put(arr, s)) \
-                if s is not None else nd.NDArray(arr)
+            if s is not None:
+                # every rank assembled the FULL global value above, so
+                # placement onto a (possibly multi-host) mesh goes
+                # through the shared staging helper -- device_put when
+                # fully addressable, per-process shard assembly on a
+                # global mesh (reshard-on-restore across topologies)
+                from ..parallel.mesh import put_replicated
+                placed[key] = nd.NDArray(put_replicated(arr, s))
+            else:
+                placed[key] = nd.NDArray(arr)
         items[item] = placed
     return items, nbytes
 

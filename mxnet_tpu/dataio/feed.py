@@ -192,10 +192,14 @@ class DeviceFeed:
             # transfer) host-side, mainly for A/B numerics runs
             x = x.astype(self._precast)
         target = self._placement(x.ndim)
-        if isinstance(target, NamedSharding) and jax.process_count() > 1 \
-                and hasattr(jax, "make_array_from_process_local_data"):
-            return jax.make_array_from_process_local_data(target, x), \
-                x.nbytes
+        if isinstance(target, NamedSharding):
+            # the shared SPMD staging path (parallel.mesh): on a
+            # multi-host global mesh the local batch lands as its slice
+            # of the global array via make_array_from_process_local_data
+            # -- the same pre-sharded batches TrainStep consumes with
+            # no re-transfer (docs/distributed.md)
+            from ..parallel.mesh import stage_process_local
+            return stage_process_local(x, target), x.nbytes
         return jax.device_put(x, target), x.nbytes
 
     @property

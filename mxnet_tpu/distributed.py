@@ -160,15 +160,38 @@ def _result_device(arr):
 def _place(x, placement):
     import jax
     import jax.numpy as jnp
-    return jax.device_put(x, placement) if placement is not None \
-        else jnp.asarray(x)
+    import numpy as np
+    if placement is None:
+        return jnp.asarray(x)
+    if isinstance(placement, jax.sharding.Sharding) \
+            and not placement.is_fully_addressable:
+        # a multi-host sharding (the global mesh of docs/distributed.md)
+        # cannot be device_put from host data; build the global array
+        # from this process's addressable shards instead -- valid here
+        # because collective results are identical on every rank
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, placement,
+                                            lambda idx: x[idx])
+    return jax.device_put(x, placement)
 
 
-def host_allreduce(arr, average=False, timeout_ms=60000):
+def _telemetry_collective(kind, nbytes, ntensors):
+    from . import telemetry as _telemetry
+    if _telemetry._ENABLED:
+        _telemetry.hooks.dist_collective(kind, nbytes, ntensors)
+
+
+def host_allreduce(arr, average=False, timeout_ms=60000, _ntensors=1):
     """Sum (or mean) a host array across every process.  Uses backend
     collectives when the backend is multi-process; otherwise the
     coordination-service KV store.  The result lands on the input's
-    device (see ``_result_device``)."""
+    device (see ``_result_device``).
+
+    NOT a training-hot-path primitive: the compiled SPMD train step
+    reduces gradients in-graph (GSPMD ``all-reduce`` over the global
+    mesh, docs/distributed.md); this host collective survives for
+    init-time broadcast and metric/overflow reduction only, and those
+    call sites coalesce tensors through the ``*_bucketed`` wrappers."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -177,6 +200,7 @@ def host_allreduce(arr, average=False, timeout_ms=60000):
     nproc, rank = world()
     if nproc == 1:
         return _place(arr, dev)
+    _telemetry_collective("allreduce", _nbytes_of(arr), _ntensors)
     if jax.process_count() == nproc:
         from jax.experimental import multihost_utils
         g = multihost_utils.process_allgather(jnp.asarray(arr))
@@ -200,9 +224,10 @@ def host_allreduce(arr, average=False, timeout_ms=60000):
     return _place(total, dev)
 
 
-def host_broadcast(arr, root=0, timeout_ms=60000):
+def host_broadcast(arr, root=0, timeout_ms=60000, _ntensors=1):
     """Every process receives root's value (placed on the input's
-    device, see ``_result_device``)."""
+    device, see ``_result_device``).  Init-time parameter sync only on
+    the SPMD path -- see ``host_allreduce``'s note."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -211,6 +236,7 @@ def host_broadcast(arr, root=0, timeout_ms=60000):
     nproc, rank = world()
     if nproc == 1:
         return _place(arr, dev)
+    _telemetry_collective("broadcast", _nbytes_of(arr), _ntensors)
     if jax.process_count() == nproc:
         from jax.experimental import multihost_utils
         out = multihost_utils.broadcast_one_to_all(
@@ -244,3 +270,100 @@ def barrier(name="mxnet_tpu_barrier", timeout_ms=60000):
         return
     _seq[0] += 1
     _client().wait_at_barrier("%s/%d" % (name, _seq[0]), timeout_ms)
+
+
+def _nbytes_of(arr):
+    try:
+        import numpy as np
+        shape = getattr(arr, "shape", ())
+        dtype = getattr(arr, "dtype", None)
+        if dtype is None:
+            return 0
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * np.dtype(dtype).itemsize
+    except Exception:
+        return 0
+
+
+# ----------------------------------------------------------------------
+# Bucketed host collectives.
+#
+# The surviving host-collective call sites (init-time parameter
+# broadcast, metric/overflow reduction, the legacy eager kvstore path)
+# used to issue ONE RPC PER TENSOR -- for an N-layer model that is N
+# coordinator round-trips before the first step.  These wrappers
+# flatten a whole list of tensors into one contiguous buffer per dtype
+# and make ONE collective per buffer, then split results back onto each
+# input's original placement.  ``dist.collectives`` vs
+# ``dist.tensors_coalesced`` telemetry records the drop.
+# ----------------------------------------------------------------------
+
+def _as_host(x):
+    """Host numpy view of one collective operand (NDArray / jax.Array /
+    numpy).  Multi-host global arrays must be fully replicated -- which
+    every replicated-parameter caller satisfies."""
+    import numpy as np
+    data = getattr(x, "_data", x)       # NDArray -> jax array
+    return np.asarray(data)
+
+
+def _bucketed(arrays, one_collective):
+    """Shared flatten/concat/split machinery: group ``arrays`` by dtype,
+    run ``one_collective(buffer, ntensors)`` once per group, and return
+    the per-input results placed back on each input's sharding."""
+    import numpy as np
+    arrays = list(arrays)
+    if not arrays:
+        return []
+    placements = [_result_device(getattr(a, "_data", a)) for a in arrays]
+    hosts = [_as_host(a) for a in arrays]
+    groups = {}                          # dtype -> [index, ...]
+    for i, h in enumerate(hosts):
+        groups.setdefault(h.dtype, []).append(i)
+    out = [None] * len(arrays)
+    for dtype, idxs in groups.items():
+        flat = [hosts[i].ravel() for i in idxs]
+        buf = np.concatenate(flat) if len(flat) > 1 else flat[0]
+        res = np.asarray(one_collective(buf, len(idxs)))
+        off = 0
+        for i in idxs:
+            n = hosts[i].size
+            piece = res[off:off + n].reshape(hosts[i].shape)
+            off += n
+            out[i] = _place(piece, placements[i])
+    return out
+
+
+def host_allreduce_bucketed(arrays, average=False, timeout_ms=60000):
+    """Sum (or mean) a LIST of host arrays across every process with
+    one flattened collective per dtype group instead of one RPC per
+    tensor.  Results come back in input order, each on its input's
+    placement."""
+    nproc, _rank = world()
+    if nproc == 1:
+        return [_place(_as_host(a),
+                       _result_device(getattr(a, "_data", a)))
+                for a in arrays]
+    return _bucketed(
+        arrays,
+        lambda buf, n: host_allreduce(buf, average=average,
+                                      timeout_ms=timeout_ms,
+                                      _ntensors=n))
+
+
+def host_broadcast_bucketed(arrays, root=0, timeout_ms=60000):
+    """Every process receives root's values for a LIST of arrays, one
+    flattened collective per dtype group (the init-time parameter-sync
+    path of docs/distributed.md)."""
+    nproc, _rank = world()
+    if nproc == 1:
+        return [_place(_as_host(a),
+                       _result_device(getattr(a, "_data", a)))
+                for a in arrays]
+    return _bucketed(
+        arrays,
+        lambda buf, n: host_broadcast(buf, root=root,
+                                      timeout_ms=timeout_ms,
+                                      _ntensors=n))

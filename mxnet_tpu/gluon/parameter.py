@@ -109,9 +109,12 @@ class Parameter:
         ini(initializer.InitDesc(self.name), data)
         if self._sharding is not None:
             # deferred-init param of a mesh-replicated block: place the
-            # fresh array with the recorded sharding (parallel.replicate_block)
-            import jax
-            data._data = jax.device_put(data._data, self._sharding)
+            # fresh array with the recorded sharding (parallel.replicate_block).
+            # put_replicated assembles the global array on a multi-host
+            # mesh; cross-rank value sync happens at the next
+            # _sync_initial_params (TrainStep._ensure_states)
+            from ..parallel.mesh import put_replicated
+            data._data = put_replicated(data._data, self._sharding)
         self._data = data
         self._deferred_init = None
         if self._grad_req != "null":

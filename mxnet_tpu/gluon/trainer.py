@@ -88,16 +88,21 @@ class Trainer:
         if self._kvstore is None or \
                 not getattr(self._kvstore, "_is_dist", False):
             return
-        from ..distributed import host_broadcast, world
+        from ..distributed import host_broadcast_bucketed, world
         if world()[0] <= 1:
             return
-        for p in self._params:
-            if p.name in self._dist_synced or p._data is None:
-                continue
-            # host_broadcast lands the result back on the input's own
-            # sharding (distributed._result_device), so mesh-sharded
-            # params keep their layout
-            p._data._data = host_broadcast(p._data._data, root=0)
+        todo = [p for p in self._params
+                if p.name not in self._dist_synced and p._data is not None]
+        if not todo:
+            return
+        # ONE flattened collective for the whole parameter set instead
+        # of one RPC per tensor; results land back on each input's own
+        # sharding (distributed._result_device), so mesh-sharded params
+        # keep their layout
+        synced = host_broadcast_bucketed([p._data._data for p in todo],
+                                         root=0)
+        for p, v in zip(todo, synced):
+            p._data._data = v
             self._dist_synced.add(p.name)
 
     def _check_and_rescale_grad(self, scale):
@@ -153,10 +158,19 @@ class Trainer:
         if self._kvstore is None:
             return
         self._sync_initial_params()   # late deferred-init params
-        for i, p in enumerate(self._params):
-            if p.grad_req != "null" and p._data is not None \
-                    and p._data._grad is not None:
-                self._kvstore.pushpull(i, p._data._grad, out=p._data._grad)
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null" and p._data is not None
+                and p._data._grad is not None]
+        if getattr(self._kvstore, "_is_dist", False):
+            # legacy eager path (the hot path is the compiled SPMD
+            # TrainStep, which never reaches here): ONE bucketed
+            # collective for the whole gradient set, not one per tensor
+            self._kvstore.pushpull_bucket(
+                [i for i, _ in live], [p._data._grad for _, p in live],
+                [p._data._grad for _, p in live])
+            return
+        for i, p in live:
+            self._kvstore.pushpull(i, p._data._grad, out=p._data._grad)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

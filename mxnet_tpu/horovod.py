@@ -53,20 +53,35 @@ def allreduce(tensor, average=True, name=None):
     return NDArray(x)
 
 
+def grouped_allreduce(tensors, average=True, name=None):
+    """``hvd.grouped_allreduce``: reduce a LIST of tensors in ONE
+    flattened collective per dtype (``host_allreduce_bucketed``)
+    instead of one RPC each -- the bucketed form metric/overflow
+    reductions should use."""
+    from .distributed import host_allreduce_bucketed, world
+    vals = [t._data if isinstance(t, NDArray) else jnp.asarray(t)
+            for t in tensors]
+    if world()[0] > 1:
+        vals = host_allreduce_bucketed(vals, average=average)
+    return [NDArray(v) for v in vals]
+
+
 def broadcast_parameters(params, root_rank=0):
     """Make every worker start from root's weights (reference:
-    ``hvd.broadcast_parameters``)."""
-    from .distributed import host_broadcast, world
+    ``hvd.broadcast_parameters``) -- ONE bucketed collective for the
+    whole parameter set, not one RPC per tensor."""
+    from .distributed import host_broadcast_bucketed, world
     if world()[0] == 1:
         return
-    items = params.items() if hasattr(params, "items") else params
-    for _name, p in items:
-        arr = p.data() if hasattr(p, "data") else p
-        # pass the device array through: host_broadcast places its
-        # result back on the input's device (an np.asarray here would
-        # both force a host fetch per parameter and land the result on
-        # the DEFAULT device -- a remote TPU on tunneled hosts)
-        arr._data = host_broadcast(arr._data, root_rank)
+    items = list(params.items() if hasattr(params, "items") else params)
+    # pass the device arrays through: the bucketed broadcast places
+    # results back on each input's device/sharding (an np.asarray here
+    # would land results on the DEFAULT device -- a remote TPU on
+    # tunneled hosts)
+    arrs = [(p.data() if hasattr(p, "data") else p) for _name, p in items]
+    out = host_broadcast_bucketed([a._data for a in arrs], root=root_rank)
+    for a, v in zip(arrs, out):
+        a._data = v
 
 
 class DistributedTrainer(Trainer):
@@ -83,11 +98,10 @@ class DistributedTrainer(Trainer):
     def step(self, batch_size, ignore_stale_grad=False):
         from .distributed import world
         if world()[0] > 1:
-            for p in self._params:
-                if p.grad_req == "null" or p._data is None \
-                        or p._data._grad is None:
-                    # mirror the base Trainer's stale-grad guard
-                    continue
-                g = p.grad()
-                g._data = allreduce(g, average=True)._data
+            grads = [p.grad() for p in self._params
+                     if p.grad_req != "null" and p._data is not None
+                     and p._data._grad is not None]  # stale-grad guard
+            reduced = grouped_allreduce(grads, average=True)
+            for g, r in zip(grads, reduced):
+                g._data = r._data
         super().step(batch_size, ignore_stale_grad)

@@ -13,11 +13,22 @@ Design (SURVEY.md §5 "Distributed communication backend"):
   therefore aggregates pushed versions and applies the optimizer, giving
   the reference's ``update_on_kvstore`` semantics without a comm step.
 - ``dist_sync`` / ``dist_device_sync`` / ``dist_async``: multi-process.
-  ``jax.distributed`` + PJRT replace the ps-lite scheduler/Van; pushes
-  allreduce across processes over DCN/ICI collectives.  The "server-side
-  optimizer" of the reference (``kvstore_dist_server.h :: DataHandleEx``)
-  becomes a replicated update after the allreduce -- same contract
-  (workers see identical post-update weights), no server role needed.
+  ``jax.distributed`` + PJRT replace the ps-lite scheduler/Van.  On the
+  TRAINING HOT PATH the dist kvstore is a **veneer over the compiled
+  SPMD step** (docs/distributed.md): ``parallel.TrainStep`` over the
+  global mesh reduces gradients IN-GRAPH (GSPMD inserts the
+  ``all-reduce``; XLA's latency-hiding scheduler overlaps it with
+  backprop), so ``push``/``pull`` move ZERO host bytes per step --
+  what remains of the kvstore's dist role is the init-time rank-0
+  parameter broadcast (``Trainer._sync_initial_params``, one bucketed
+  collective) and optimizer-state save/load.  The eager
+  ``push``/``pull``/``pushpull`` verbs below still reduce across
+  processes (host collectives, bucketed via ``pushpull_bucket``) for
+  reference-API compatibility and non-compiled loops.  The
+  "server-side optimizer" of the reference (``kvstore_dist_server.h ::
+  DataHandleEx``) becomes a replicated update after the allreduce --
+  same contract (workers see identical post-update weights), no server
+  role needed.
   ``dist_async`` shares this path by DESIGN: the reference's async mode
   exists to hide ps-lite server latency by applying per-worker pushes
   without aggregation (stale weights as the price); with XLA's async
@@ -249,6 +260,50 @@ class KVStore:
             for o in outs:
                 o._data = result
         return out
+
+    def pushpull_bucket(self, keys, values, outs, priority=0):
+        """Bucketed fused push+pull over a LIST of keys: dense values
+        merge per key, coalesce into one flattened buffer per dtype,
+        and cross the process boundary in ONE collective
+        (``distributed.host_allreduce_bucketed``) instead of one RPC
+        per tensor -- the legacy eager path's analog of the compiled
+        step's single in-graph all-reduce.  Telemetry records ONE
+        ``kvstore.pushpull`` call for the whole bucket (the call-count
+        drop ``kv.bytes`` proves).  Keys with sparse gradients or an
+        installed updater fall back to per-key :meth:`pushpull`."""
+        keys = [self._keyify(k) for k in keys]
+        t0 = time.perf_counter() if _telemetry._ENABLED else None
+        dense_idx, merged_vals = [], []
+        for j, (key, value) in enumerate(zip(keys, values)):
+            if self._updater is not None:
+                self.pushpull(key, value, outs[j], priority)
+                continue
+            merged, sparse_grad = self._merge(value), False
+            sparse_grad = isinstance(merged, _sp.BaseSparseNDArray)
+            if sparse_grad:
+                merged = merged.todense()._data
+            if self._compression is not None:
+                merged = self._compression.compress_decompress(key, merged)
+            dense_idx.append(j)
+            merged_vals.append(merged)
+        if not dense_idx:
+            return outs
+        from .distributed import world
+        if self._is_dist and world()[0] > 1:
+            from .distributed import host_allreduce_bucketed
+            merged_vals = host_allreduce_bucketed(merged_vals)
+        total = 0
+        for j, res in zip(dense_idx, merged_vals):
+            res = res._data if isinstance(res, NDArray) else res
+            total += _value_nbytes(values[j])
+            os_ = outs[j] if isinstance(outs[j], (list, tuple)) \
+                else [outs[j]]
+            for o in os_:
+                o._data = res
+        if t0 is not None:
+            _telemetry.hooks.kv_op("pushpull", total,
+                                   time.perf_counter() - t0)
+        return outs
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull ONLY the requested rows (reference: ``PullRowSparse``).

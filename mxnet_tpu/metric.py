@@ -62,6 +62,23 @@ class EvalMetric:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
 
+    def get_global(self):
+        """``(name, value)`` reduced across every process of a
+        multi-host run: local ``(sum_metric, num_inst)`` pairs ride ONE
+        bucketed host collective (the metric-reduction survivor of the
+        one-program SPMD contract, docs/distributed.md) -- never a
+        per-metric RPC.  Single-process this is :meth:`get`."""
+        from .distributed import host_allreduce_bucketed, world
+        if world()[0] == 1:
+            return self.get()
+        import numpy as np
+        stats = np.asarray([float(self.sum_metric),
+                            float(self.num_inst)], np.float64)
+        total = np.asarray(host_allreduce_bucketed([stats])[0])
+        if total[1] == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(total[0] / total[1]))
+
     def get_name_value(self):
         name, value = self.get()
         if not isinstance(name, list):

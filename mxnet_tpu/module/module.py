@@ -220,15 +220,19 @@ class Module(BaseModule):
         if self._kvstore is not None and \
                 getattr(self._kvstore, "_is_dist", False):
             # rank 0's parameters + aux go to every worker (reference
-            # kv.init + pull), however the kvstore was supplied
-            from ..distributed import host_broadcast, world
+            # kv.init + pull), however the kvstore was supplied -- ONE
+            # bucketed collective for the whole set, not one per tensor
+            from ..distributed import host_broadcast_bucketed, world
             if world()[0] > 1:
-                for name in self._param_names:
-                    if name in self._exec.arg_dict:
-                        arr = self._exec.arg_dict[name]
-                        arr._data = host_broadcast(arr._data, root=0)
-                for name, arr in self._exec.aux_dict.items():
-                    arr._data = host_broadcast(arr._data, root=0)
+                arrs = [self._exec.arg_dict[name]
+                        for name in self._param_names
+                        if name in self._exec.arg_dict]
+                arrs += [arr for _name, arr in
+                         sorted(self._exec.aux_dict.items())]
+                out = host_broadcast_bucketed([a._data for a in arrs],
+                                              root=0)
+                for a, v in zip(arrs, out):
+                    a._data = v
         self.optimizer_initialized = True
         if getattr(self, "_preloaded_states", None):
             self.load_optimizer_states(self._preloaded_states)

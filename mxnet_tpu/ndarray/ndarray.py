@@ -149,10 +149,21 @@ class NDArray:
     def context(self):
         if _is_traced(self._data):
             return current_context()
-        dev = next(iter(self._data.devices()))
-        if dev.platform == "cpu":
-            return Context("cpu", dev.id)
-        return Context("tpu", dev.id)
+        # for a multi-host global array (SPMD global mesh), the context
+        # must name a device THIS process can address, by its LOCAL
+        # ordinal -- a raw global device id indexes out of the
+        # per-worker device list Context.jax_device resolves against
+        sharding = getattr(self._data, "sharding", None)
+        addr = getattr(sharding, "addressable_devices", None)
+        dev = min(addr, key=lambda d: d.id) if addr else \
+            next(iter(self._data.devices()))
+        name = "cpu" if dev.platform == "cpu" else "tpu"
+        from ..context import _jax_devices_for
+        try:
+            ordinal = _jax_devices_for(name).index(dev)
+        except ValueError:
+            ordinal = dev.id
+        return Context(name, ordinal)
 
     ctx = context
 

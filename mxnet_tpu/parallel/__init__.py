@@ -5,7 +5,8 @@ TPU-native replacement for the reference's multi-device stack (SURVEY.md
 parameter copies, CommDevice reduction, and NCCL.
 """
 from .mesh import (Mesh, NamedSharding, PartitionSpec, default_mesh,
-                   local_devices, make_mesh)
+                   global_mesh, local_devices, make_mesh, put_replicated,
+                   stage_process_local)
 from .data_parallel import (TrainStep, replicate_block, shard_batch,
                             split_and_load)
 from .sequence import ring_attention, ring_attention_sharded
@@ -16,7 +17,8 @@ from .pipeline import (pipeline_apply, shard_stacked_params,
 from .moe import MixtureOfExperts, moe_load_balancing_loss
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "default_mesh",
-           "local_devices", "make_mesh", "TrainStep", "replicate_block",
+           "global_mesh", "local_devices", "make_mesh", "put_replicated",
+           "stage_process_local", "TrainStep", "replicate_block",
            "shard_batch", "split_and_load", "ring_attention",
            "ring_attention_sharded", "ColumnParallelDense",
            "RowParallelDense", "TensorParallelMLP", "shard_block_tp",

@@ -32,6 +32,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import profiling as _profiling
 from .. import random as _random_mod
+from .mesh import global_mesh, put_replicated, stage_process_local
 
 __all__ = ["replicate_block", "shard_batch", "split_and_load", "TrainStep"]
 
@@ -48,9 +49,10 @@ def _feed_scalar(val, dtype, sharding=None):
     that ``transfer_guard("disallow")`` rejects -- and an unplaced feed
     would be resharded device-to-device at dispatch; the guard must
     stay armable over the steady-state step loop so only genuine leaks
-    raise (docs/sharding.md)."""
+    raise (docs/sharding.md).  ``put_replicated`` keeps this valid on a
+    multi-host global mesh (the scalar is identical on every rank)."""
     x = np.asarray(val, dtype)
-    return jax.device_put(x, sharding) if sharding is not None \
+    return put_replicated(x, sharding) if sharding is not None \
         else jax.device_put(x)
 
 
@@ -64,17 +66,40 @@ def replicate_block(block_or_params, mesh):
     """Place every initialized parameter (and its grad buffer) replicated
     over the mesh.  The reference analog is ``ParameterDict.reset_ctx`` to
     a list of contexts; one replicated jax.Array replaces the per-device
-    copy list."""
+    copy list.
+
+    On a multi-host global mesh the value must be IDENTICAL on every
+    rank before global placement (each process contributes its
+    addressable shards): every not-yet-placed parameter is first synced
+    from rank 0 through ONE bucketed host broadcast, then assembled
+    into the global replicated array."""
     params = block_or_params
     if hasattr(params, "collect_params"):
         params = params.collect_params()
     sh = _replicated(mesh)
+    todo = []
     for p in params.values():
         p._sharding = sh  # consumed by Parameter._finish_init for deferred
-        if p._data is not None:
+        if p._data is None:
+            continue
+        if not p._data._data.sharding.is_equivalent_to(
+                sh, p._data._data.ndim):
+            todo.append(p)
+    if todo and not getattr(sh, "is_fully_addressable", True):
+        from ..distributed import host_broadcast_bucketed
+        synced = host_broadcast_bucketed(
+            [np.asarray(p._data._data) for p in todo])
+        for p, v in zip(todo, synced):
+            p._data._data = put_replicated(np.asarray(v), sh)
+            if p._data._grad is not None:
+                p._data._grad._data = put_replicated(
+                    np.asarray(p._data._grad._data), sh)
+    else:
+        for p in todo:
             p._data._data = jax.device_put(p._data._data, sh)
             if p._data._grad is not None:
-                p._data._grad._data = jax.device_put(p._data._grad._data, sh)
+                p._data._grad._data = jax.device_put(p._data._grad._data,
+                                                     sh)
     return block_or_params
 
 
@@ -83,15 +108,19 @@ def shard_batch(data, mesh, batch_axis=0, axis_name="dp"):
 
     Returns an NDArray backed by a single global jax.Array whose shards
     live on the mesh devices (the reference's
-    ``DataParallelExecutorGroup`` batch slicing, done by sharding)."""
+    ``DataParallelExecutorGroup`` batch slicing, done by sharding).  On
+    a multi-host mesh the input is this process's LOCAL batch and the
+    result is the (nproc x local) global batch
+    (``mesh.stage_process_local``)."""
     x = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-    n = mesh.shape[axis_name]
-    if x.shape[batch_axis] % n:
-        raise MXNetError(
-            "batch axis %d (size %d) not divisible by %s=%d"
-            % (batch_axis, x.shape[batch_axis], axis_name, n))
-    return NDArray(jax.device_put(
-        x, _batch_sharding(mesh, x.ndim, batch_axis, axis_name)))
+    sh = _batch_sharding(mesh, x.ndim, batch_axis, axis_name)
+    if getattr(sh, "is_fully_addressable", True):
+        n = mesh.shape[axis_name]
+        if x.shape[batch_axis] % n:
+            raise MXNetError(
+                "batch axis %d (size %d) not divisible by %s=%d"
+                % (batch_axis, x.shape[batch_axis], axis_name, n))
+    return NDArray(stage_process_local(x, sh))
 
 
 def split_and_load(data, ctx_list=None, mesh=None, batch_axis=0,
@@ -220,9 +249,23 @@ class TrainStep:
         self._block = block
         self._loss_fn = loss_fn
         self._trainer = trainer
+        if mesh is None and jax.process_count() > 1:
+            # multi-host world: default to ONE SPMD program over the
+            # global mesh -- gradients allreduce in-graph (GSPMD psum),
+            # the kvstore is an init-time veneer (docs/distributed.md)
+            mesh = global_mesh()
         self._mesh = mesh
         self._batch_axis = batch_axis
         self._axis_name = axis_name
+        if donate and mesh is not None and jax.process_count() > 1 \
+                and jax.default_backend() == "cpu":
+            # jaxlib 0.4.x gloo CPU collectives + donated buffers
+            # corrupt the heap after a few dispatches (glibc "corrupted
+            # double-linked list" abort, reproduced in-suite); donation
+            # is an HBM optimization with no meaning for host memory,
+            # so the multi-process CPU/gloo path runs undonated.  TPU
+            # pods (ICI collectives) keep donation.
+            donate = False
         self._donate = donate
         self._cache = {}
         if mesh is not None:
@@ -233,6 +276,11 @@ class TrainStep:
         tr = self._trainer
         if not tr._kv_initialized:
             tr._init_kvstore()
+        elif getattr(tr._kvstore, "_is_dist", False):
+            # late deferred-init params (materialized by the probe
+            # forward) still need the one-time rank-0 sync; bucketed,
+            # init-time only -- the step itself moves no host bytes
+            tr._sync_initial_params()
         upd = tr._updater
         opt = tr._optimizer
         for i, p in enumerate(tr._params):
@@ -245,12 +293,43 @@ class TrainStep:
             for s in upd.states.values():
                 for leaf in _state_leaves(s):
                     if not leaf._data.sharding.is_equivalent_to(sh, leaf._data.ndim):
-                        leaf._data = jax.device_put(leaf._data, sh)
+                        leaf._data = put_replicated(leaf._data, sh)
 
     def _diff_indices(self):
         tr = self._trainer
         return [i for i, p in enumerate(tr._params)
                 if p.grad_req != "null" and p._data is not None]
+
+    def _stage_io(self, data, label, shift=0):
+        """Stage one (data, label) pair for dispatch.  Host batches land
+        through the EXPLICIT staging primitives (guard-clean under
+        ``transfer_guard("disallow")``); device arrays reshard only when
+        their sharding differs from the target.  With a mesh the batch
+        axis shards over ``dp`` -- and on a multi-host global mesh the
+        input is this process's LOCAL batch, staged as its slice of the
+        global batch (``mesh.stage_process_local``), so the compiled
+        step is ONE SPMD program over pre-sharded inputs."""
+        if self._mesh is None:
+            if not isinstance(data, NDArray):
+                data = NDArray(jnp.asarray(data))
+            if not isinstance(label, NDArray):
+                label = NDArray(jnp.asarray(label))
+            return data, label
+        dx = data._data if isinstance(data, NDArray) else data
+        lx = label._data if isinstance(label, NDArray) else label
+        if not isinstance(dx, jax.Array):
+            dx = np.asarray(dx)
+        if not isinstance(lx, jax.Array):
+            lx = np.asarray(lx)
+        if getattr(dx, "ndim", 0):
+            want = _batch_sharding(self._mesh, dx.ndim,
+                                   self._batch_axis + shift,
+                                   self._axis_name)
+            lsh = _batch_sharding(self._mesh, lx.ndim, shift,
+                                  self._axis_name)
+            dx = stage_process_local(dx, want)
+            lx = stage_process_local(lx, lsh)
+        return NDArray(dx), NDArray(lx)
 
     # -- compilation ---------------------------------------------------
     def _build(self, ivals, training):
@@ -398,20 +477,8 @@ class TrainStep:
                     and p._data._data.dtype != p.dtype:
                 p.cast(p.dtype)
         self._ensure_states()
-        if not isinstance(data, NDArray):
-            data = NDArray(jnp.asarray(data))
-        if not isinstance(label, NDArray):
-            label = NDArray(jnp.asarray(label))
-        if self._mesh is not None and data._data.ndim:
-            # leading axis is the step index; batch axis shifts right by 1
-            want = _batch_sharding(self._mesh, data._data.ndim,
-                                   self._batch_axis + 1, self._axis_name)
-            if not data._data.sharding.is_equivalent_to(want,
-                                                        data._data.ndim):
-                data = NDArray(jax.device_put(data._data, want))
-                lsh = _batch_sharding(self._mesh, label._data.ndim, 1,
-                                      self._axis_name)
-                label = NDArray(jax.device_put(label._data, lsh))
+        # leading axis is the step index; batch axis shifts right by 1
+        data, label = self._stage_io(data, label, shift=1)
         if any(p._deferred_init is not None
                for p in self._block._all_params()):
             from .. import autograd as _ag
@@ -547,19 +614,7 @@ class TrainStep:
                     and p._data._data.dtype != p.dtype:
                 p.cast(p.dtype)
         self._ensure_states()
-        if not isinstance(data, NDArray):
-            data = NDArray(jnp.asarray(data))
-        if not isinstance(label, NDArray):
-            label = NDArray(jnp.asarray(label))
-        if self._mesh is not None and data._data.ndim:
-            sh = data._data.sharding
-            want = _batch_sharding(self._mesh, data._data.ndim,
-                                   self._batch_axis, self._axis_name)
-            if not sh.is_equivalent_to(want, data._data.ndim):
-                data = NDArray(jax.device_put(data._data, want))
-                lsh = _batch_sharding(self._mesh, label._data.ndim, 0,
-                                      self._axis_name)
-                label = NDArray(jax.device_put(label._data, lsh))
+        data, label = self._stage_io(data, label)
         if any(p._deferred_init is not None
                for p in self._block._all_params()):
             # materialize deferred shapes with one eager forward;

@@ -18,7 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec",
-           "local_devices", "default_mesh", "AXIS_ROLES"]
+           "local_devices", "default_mesh", "global_mesh", "AXIS_ROLES",
+           "put_replicated", "stage_process_local"]
 
 # Canonical mesh-axis vocabulary.  Axis names are arbitrary strings to
 # XLA, but the parallel layers, the docs, and the sharding sanitizer
@@ -84,3 +85,67 @@ def default_mesh():
             _default_mesh.devices.size != len(jax.devices()):
         _default_mesh = make_mesh({"dp": -1})
     return _default_mesh
+
+
+_global_meshes = {}
+
+
+def global_mesh(axes=None):
+    """The ONE mesh a multi-host SPMD program runs over: every device
+    of every process in the ``jax.distributed`` world (``jax.devices()``
+    spans hosts once ``distributed_init`` ran).  Default axes:
+    ``{"dp": -1}`` -- pure data parallel; pass e.g.
+    ``{"dp": -1, "tp": 2}`` for a 2-D data x model mesh.  Cached per
+    (axes, world size), so every caller -- ``TrainStep``, ``DeviceFeed``,
+    checkpoint resharding -- agrees on one device order
+    (docs/distributed.md)."""
+    axes = OrderedDict(axes if axes is not None else {"dp": -1})
+    if "dp" not in axes:
+        raise MXNetError("global_mesh needs a 'dp' axis (got %r)"
+                         % list(axes))
+    key = (tuple(axes.items()), len(jax.devices()))
+    mesh = _global_meshes.get(key)
+    if mesh is None:
+        mesh = _global_meshes[key] = make_mesh(axes)
+    return mesh
+
+
+def put_replicated(x, sharding):
+    """Place one host/device value replicated onto a (possibly
+    multi-host) sharding.  Single-process this is ``jax.device_put``;
+    in a multi-process world a host value cannot be device_put onto
+    non-addressable devices, so the global array is assembled from this
+    process's addressable shards -- callers must have synchronized the
+    value across ranks first (``distributed.host_broadcast_bucketed``),
+    or ranks silently diverge."""
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(x, sharding)
+    x = np.asarray(x)
+    # make_array_from_callback's internal batched_device_put counts as
+    # an IMPLICIT transfer under jax.transfer_guard("disallow"), but
+    # this call IS the library's explicit placement primitive (morally
+    # jax.device_put, which the guard exempts) -- allow it locally so
+    # the guard stays armable over the steady-state step loop
+    with jax.transfer_guard("allow"):
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+
+def stage_process_local(x, sharding):
+    """Land one PROCESS-LOCAL batch shard as its slice of the global
+    array (``jax.make_array_from_process_local_data``): every process
+    contributes its local batch and the result is the (nproc x local)
+    global batch sharded per ``sharding``.  Single-process (or already
+    correctly sharded) inputs take the plain ``device_put`` path.  The
+    staging half of the one-program SPMD contract -- batches arrive
+    pre-sharded, the compiled step never re-transfers."""
+    if isinstance(x, jax.Array) and \
+            x.sharding.is_equivalent_to(sharding, x.ndim):
+        return x
+    if getattr(sharding, "is_fully_addressable", True):
+        return jax.device_put(
+            x if isinstance(x, jax.Array) else np.asarray(x), sharding)
+    x = np.asarray(x)
+    # explicit staging primitive: see put_replicated's guard note
+    with jax.transfer_guard("allow"):
+        return jax.make_array_from_process_local_data(sharding, x)

@@ -184,6 +184,22 @@ def kv_op(verb, nbytes, seconds=None):
         reg.timer("kvstore.time").observe(seconds, verb=verb)
 
 
+def dist_collective(kind, nbytes, ntensors=1):
+    """One host-side cross-process collective (distributed.py).  The
+    hot training path moves ZERO bytes through here (gradients reduce
+    in-graph, docs/distributed.md); what remains is init-time broadcast
+    and metric/overflow reduction, and the bucketed wrappers coalesce
+    N tensors into one call -- ``dist.collectives`` vs
+    ``dist.tensors_coalesced`` is the call-count-drop proof."""
+    reg = _registry()
+    reg.counter("dist.collectives").inc()
+    reg.counter("dist." + kind).inc()
+    if nbytes:
+        reg.counter("dist.bytes").inc(int(nbytes))
+    if ntensors:
+        reg.counter("dist.tensors_coalesced").inc(int(ntensors))
+
+
 def dataloader_wait(seconds):
     reg = _registry()
     reg.counter("data.batches").inc()

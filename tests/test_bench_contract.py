@@ -38,6 +38,19 @@ def bench_mod(monkeypatch):
                         lambda *a, **k: (1500.0, 5000.0, {}))
     monkeypatch.setattr(bench, "_cpu_subprocess_value",
                         lambda *a, **k: 1000.0)
+    monkeypatch.setattr(bench, "_multichip_scaling_rows",
+                        lambda *a, **k: [
+                            {"n_devices": 1, "img_per_s": 1000.0,
+                             "per_device_img_per_s": 1000.0,
+                             "efficiency": 1.0, "collectives": {},
+                             "collective_bytes": 0},
+                            {"n_devices": 2, "img_per_s": 1800.0,
+                             "per_device_img_per_s": 900.0,
+                             "efficiency": 0.9,
+                             "collectives": {"all-reduce":
+                                             {"count": 7,
+                                              "bytes": 67884}},
+                             "collective_bytes": 67884}])
     monkeypatch.setattr(bench, "_subprocess_pair",
                         lambda *a, **k: (2000.0, 0.8))
     # _emit_with_retry sleeps between real retries; stubs don't need it
@@ -198,6 +211,43 @@ def test_serving_curve_emits(bench_mod, capsys):
     for key in ("offered_qps", "qps", "p50_ms", "p95_ms", "p99_ms",
                 "mean_occupancy", "shed"):
         assert key in level, key
+
+
+def test_multichip_scaling_line_emits(bench_mod, capsys):
+    """ISSUE 9 bench contract: the MULTICHIP scaling line rides one
+    JSONL line with img/s, per-device efficiency, and in-graph
+    collective bytes per device count."""
+    bench_mod.main()
+    _metrics_list, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    rec = by["multichip_scaling"]
+    assert rec["unit"] == "img/s"
+    rows = rec["scaling"]
+    assert [r["n_devices"] for r in rows] == [1, 2]
+    for r in rows:
+        for key in ("img_per_s", "per_device_img_per_s", "efficiency",
+                    "collectives", "collective_bytes"):
+            assert key in r, key
+    # multi-device rows must carry the in-graph gradient all-reduce
+    assert rows[1]["collectives"]["all-reduce"]["bytes"] > 0
+
+
+def test_multichip_scaling_real_two_device(monkeypatch):
+    """The UNSTUBBED sweep on the suite's virtual devices: the 2-device
+    compiled step's collective profile lists the GSPMD gradient
+    all-reduce with non-zero bytes (in-graph, not host kvstore)."""
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    rows = bench.bench_multichip_scaling(device_counts=(1, 2),
+                                         batch_per_device=8, iters=2,
+                                         warmup=1)
+    assert rows[0]["collective_bytes"] == 0
+    assert rows[0]["efficiency"] == 1.0
+    two = rows[1]
+    assert two["n_devices"] == 2
+    assert two["collectives"]["all-reduce"]["count"] > 0
+    assert two["collective_bytes"] > 0
+    assert two["img_per_s"] > 0 and two["efficiency"] > 0
 
 
 def test_scan_failure_falls_back_for_headline(bench_mod, capsys,

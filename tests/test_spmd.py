@@ -1,0 +1,271 @@
+"""One-program SPMD building blocks (ISSUE 9), single-process half.
+
+The 2-/4-process gloo contracts live in tests/test_distributed.py;
+these tests pin the primitives they compose: the global mesh, the
+multi-host-safe placement/staging helpers, the bucketed host
+collectives (one flattened RPC per call site instead of one per
+tensor), and the kvstore/Trainer veneer plumbing.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import distributed as dist
+from mxnet_tpu import gluon, telemetry
+from mxnet_tpu.parallel import (TrainStep, global_mesh, make_mesh,
+                                put_replicated, shard_batch,
+                                stage_process_local)
+
+
+# ----------------------------------------------------------------------
+# global mesh + placement/staging helpers
+# ----------------------------------------------------------------------
+
+def test_global_mesh_default_and_2d():
+    mesh = global_mesh()
+    assert mesh.shape["dp"] == len(jax.devices())
+    assert global_mesh() is mesh              # cached per (axes, world)
+    mesh2 = global_mesh({"dp": -1, "tp": 2})
+    assert mesh2.shape["tp"] == 2
+    assert mesh2.shape["dp"] * 2 == len(jax.devices())
+    with pytest.raises(mx.base.MXNetError):
+        global_mesh({"tp": 2})                # dp axis is mandatory
+
+
+def test_put_replicated_single_process_is_device_put():
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P())
+    out = put_replicated(np.arange(6, dtype=np.float32), sh)
+    assert out.sharding.is_equivalent_to(sh, out.ndim)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(6))
+
+
+def test_stage_process_local_noop_when_equivalent():
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P("dp"))
+    staged = stage_process_local(np.arange(8, dtype=np.float32), sh)
+    assert staged.sharding.is_equivalent_to(sh, staged.ndim)
+    assert stage_process_local(staged, sh) is staged
+
+
+def test_shard_batch_accepts_host_numpy():
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    out = shard_batch(np.ones((8, 3), np.float32), mesh)
+    assert out._data.sharding.is_equivalent_to(
+        NamedSharding(mesh, P("dp", None)), 2)
+
+
+def test_train_step_host_batches_guard_clean():
+    """Host numpy batches land through the EXPLICIT staging primitives:
+    the steady-state step loop stays clean under
+    transfer_guard('disallow') -- the contract the multi-host feed
+    depends on (docs/distributed.md)."""
+    from mxnet_tpu.analysis import sharding as shard_mod
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.L2Loss(), tr, mesh=mesh)
+    x = np.random.rand(8, 5).astype(np.float32)
+    y = np.random.rand(8, 3).astype(np.float32)
+    step(x, y)                                # compile outside the guard
+    with shard_mod.transfer_guard("disallow"):
+        loss = step(x, y)
+        loss._data.block_until_ready()
+    assert np.isfinite(float(np.asarray(loss._data)))
+
+
+# ----------------------------------------------------------------------
+# bucketed host collectives
+# ----------------------------------------------------------------------
+
+def test_bucketed_world1_passthrough():
+    arrs = [np.arange(4, dtype=np.float32),
+            np.ones((2, 2), np.int32)]
+    out = dist.host_allreduce_bucketed(arrs)
+    for a, b in zip(arrs, out):
+        np.testing.assert_array_equal(np.asarray(b), a)
+    out = dist.host_broadcast_bucketed(arrs)
+    for a, b in zip(arrs, out):
+        np.testing.assert_array_equal(np.asarray(b), a)
+
+
+def test_bucketed_one_collective_per_dtype_group(monkeypatch):
+    """3 fp32 + 2 int32 tensors coalesce into exactly TWO flattened
+    collectives (one per dtype), results split back by shape."""
+    calls = []
+
+    def fake_allreduce(buf, average=False, timeout_ms=0, _ntensors=1):
+        calls.append((buf.dtype, buf.size, _ntensors))
+        return buf * 2
+
+    monkeypatch.setattr(dist, "world", lambda: (2, 0))
+    monkeypatch.setattr(dist, "host_allreduce", fake_allreduce)
+    arrs = [np.arange(4, dtype=np.float32),
+            np.ones((2, 3), np.float32),
+            np.full(5, 7.0, np.float32),
+            np.arange(6, dtype=np.int32).reshape(2, 3),
+            np.ones(2, np.int32)]
+    out = dist.host_allreduce_bucketed(arrs)
+    assert len(calls) == 2
+    assert {c[0].name for c in calls} == {"float32", "int32"}
+    assert {(c[1], c[2]) for c in calls} == {(15, 3), (8, 2)}
+    for a, b in zip(arrs, out):
+        assert np.asarray(b).shape == a.shape
+        np.testing.assert_array_equal(np.asarray(b), a * 2)
+
+
+def test_bucketed_broadcast_places_back_on_sharding(monkeypatch):
+    """Results land back on each input's own sharding (mesh-replicated
+    params keep their layout through the init-time sync)."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    sh = NamedSharding(mesh, P())
+    dev = jax.device_put(np.arange(3, dtype=np.float32), sh)
+    monkeypatch.setattr(dist, "world", lambda: (2, 0))
+    monkeypatch.setattr(
+        dist, "host_broadcast",
+        lambda buf, root=0, timeout_ms=0, _ntensors=1: buf)
+    out = dist.host_broadcast_bucketed([dev])[0]
+    assert out.sharding.is_equivalent_to(sh, out.ndim)
+
+
+def test_dist_collective_telemetry(monkeypatch):
+    """The real collective sites feed dist.* counters: collectives vs
+    tensors_coalesced is the call-count-drop proof."""
+    monkeypatch.setattr(dist, "world", lambda: (2, 0))
+    # short-circuit at the pod branch boundary: count telemetry only
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(dist, "_warn_kv_fallback", lambda: None)
+    monkeypatch.setattr(dist, "_client", lambda: None)
+
+    was = telemetry.enabled()
+    telemetry.enable()
+    telemetry.reset("dist.")
+    try:
+        calls = []
+        monkeypatch.setattr(
+            dist, "host_allreduce",
+            lambda buf, average=False, timeout_ms=0, _ntensors=1:
+            (dist._telemetry_collective("allreduce", buf.nbytes,
+                                        _ntensors), buf)[1])
+        arrs = [np.ones(3, np.float32), np.ones(4, np.float32),
+                np.ones(5, np.float32)]
+        dist.host_allreduce_bucketed(arrs)
+        assert telemetry.counter("dist.collectives").value == 1
+        assert telemetry.counter("dist.tensors_coalesced").value == 3
+        assert telemetry.counter("dist.bytes").value == 12 * 4
+    finally:
+        if not was:
+            telemetry.disable()
+
+
+# ----------------------------------------------------------------------
+# kvstore / Trainer veneer
+# ----------------------------------------------------------------------
+
+def test_kvstore_pushpull_bucket_values_and_telemetry():
+    kv = mx.kv.create("dist_sync")           # world == 1 in-suite
+    kv.init("a", mx.nd.zeros((3,)))
+    kv.init("b", mx.nd.zeros((2, 2)))
+    va = mx.nd.ones((3,)) * 2
+    vb = mx.nd.ones((2, 2)) * 5
+    oa, ob = mx.nd.zeros((3,)), mx.nd.zeros((2, 2))
+    was = telemetry.enabled()
+    telemetry.enable()
+    telemetry.reset("kvstore.")
+    try:
+        kv.pushpull_bucket(["a", "b"], [va, vb], [oa, ob])
+        # ONE pushpull for the whole bucket (the kv.bytes call-count
+        # drop), bytes covering both tensors
+        assert telemetry.counter("kvstore.pushpull").value == 1
+        assert telemetry.counter("kvstore.bytes").value == (3 + 4) * 4
+    finally:
+        if not was:
+            telemetry.disable()
+    np.testing.assert_allclose(oa.asnumpy(), np.full(3, 2.0))
+    np.testing.assert_allclose(ob.asnumpy(), np.full((2, 2), 5.0))
+
+
+def test_kvstore_pushpull_bucket_updater_fallback():
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    g = mx.nd.ones((4,))
+    out = mx.nd.zeros((4,))
+    kv.pushpull_bucket(["w"], [g], [out])
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, -1.0))
+
+
+def test_trainer_dist_allreduce_is_bucketed():
+    """The legacy eager dist path coalesces the WHOLE gradient set into
+    one kvstore call per step (the compiled TrainStep path makes even
+    that zero)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="dist_sync")
+    from mxnet_tpu import autograd
+    x = mx.nd.ones((4, 6))
+    y = mx.nd.ones((4, 2))
+    loss_fn = gluon.loss.L2Loss()
+    was = telemetry.enabled()
+    telemetry.enable()
+    telemetry.reset("kvstore.")
+    try:
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        tr.step(4)
+        # 4 gradient tensors, ONE bucketed pushpull
+        assert telemetry.counter("kvstore.pushpull").value == 1
+    finally:
+        if not was:
+            telemetry.disable()
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_metric_get_global(monkeypatch):
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.9, 0.1],
+                                                  [0.2, 0.8]])])
+    name, val = m.get_global()               # world == 1: same as get()
+    assert (name, val) == m.get()
+    # simulate a 2-rank world where the peer got 0/2 right: the global
+    # accuracy pools (sum_metric, num_inst) in ONE bucketed collective
+    monkeypatch.setattr(dist, "world", lambda: (2, 0))
+    calls = []
+
+    def fake_bucketed(arrs, average=False, timeout_ms=0):
+        calls.append(len(arrs))
+        return [np.asarray(a) * 2 for a in arrs]  # peer mirrors local
+
+    monkeypatch.setattr(dist, "host_allreduce_bucketed", fake_bucketed)
+    name, val = m.get_global()
+    assert calls == [1]
+    assert val == pytest.approx(m.get()[1])
+
+
+def test_horovod_grouped_allreduce_world1():
+    from mxnet_tpu import horovod as hvd
+    outs = hvd.grouped_allreduce([mx.nd.ones((2,)) * 3,
+                                  mx.nd.ones((3,)) * 4])
+    np.testing.assert_allclose(outs[0].asnumpy(), np.full(2, 3.0))
+    np.testing.assert_allclose(outs[1].asnumpy(), np.full(3, 4.0))
+
+
+def test_context_of_mesh_sharded_array_is_addressable():
+    """NDArray.context on a mesh-global array names an addressable
+    device by LOCAL ordinal (a raw global id breaks eager state
+    creation on non-zero ranks)."""
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    x = mx.nd.NDArray(jax.device_put(np.ones(4, np.float32),
+                                     NamedSharding(mesh, P())))
+    ctx = x.context
+    assert ctx.jax_device() in jax.local_devices()
