@@ -33,6 +33,15 @@
 #           rank 0's collective contract must match the committed
 #           ci/sharding_baseline.json (the gradient all-reduce is
 #           blessed; anything else fails naming executable+kind)
+#   perflint -> TPU performance linter gates (docs/perf_lint.md): the
+#               full-tree static pass with all five perf rules armed
+#               (layout-hostile-conv, pad-waste, python-loop-unroll,
+#               scalar-recompile, eager-in-step-loop), then a LeNet
+#               TrainStep + ResNet18-thumbnail forward smoke whose
+#               compiled-HLO efficiency audit (transpose share,
+#               unfused elementwise bytes, MXU pad waste, intensity)
+#               must show zero drift against the committed
+#               ci/perf_baseline.json (mxlint --perf-diff)
 #   shardlint -> sharding sanitizer gates (docs/sharding.md): the
 #                full-tree static pass (mesh axes, shard_map arity,
 #                donation audit, implicit reshard), then a LeNet
@@ -51,7 +60,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling shardlint spmd serving bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint spmd serving bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -332,6 +341,72 @@ EOF
     # gate 2: a run diffed against itself must report ZERO drift
     python -m mxnet_tpu.profiling diff "$pdir/report.json" "$pdir/report.json"
     rm -rf "$pdir"
+}
+
+run_perflint() {
+    log "perflint: full-tree static pass (five perf rules armed)"
+    # same framework as the lint stage; running --self here keeps the
+    # stage self-contained when invoked alone (ci/run_all.sh perflint)
+    python -m mxnet_tpu.analysis --self --json
+    log "perflint: compiled-audit zero-drift gate (LeNet TrainStep + ResNet18 forward)"
+    pfdir=$(mktemp -d /tmp/mxtpu_perf_ci.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_PROFILING=1 python - "$pfdir" <<'EOF'
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiling
+from mxnet_tpu.analysis import perf
+from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+from mxnet_tpu.parallel import TrainStep
+
+pfdir = sys.argv[1]
+assert profiling.enabled(), "MXNET_TPU_PROFILING=1 did not arm capture"
+
+
+class PerfLeNet(gluon.nn.HybridSequential):
+    """Named so the audit row is stable across CI runs."""
+
+
+net = PerfLeNet()
+net.add(gluon.nn.Conv2D(8, 5, padding=2, activation="relu",
+                        layout="NCHW"),
+        gluon.nn.MaxPool2D(2, layout="NCHW"),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr,
+                 mesh=None)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(8, 1, 16, 16).astype(np.float32))
+y = mx.nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
+for _ in range(2):
+    loss = step(x, y)
+loss.asnumpy()
+
+res = resnet18_v1(classes=10, thumbnail=True)
+res.initialize(ctx=mx.cpu())
+res.hybridize()
+rx = mx.nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+res(rx).asnumpy()     # first pass runs eagerly (deferred shape init)
+res(rx).asnumpy()     # second pass compiles the whole net: hybrid:ResNetV1
+
+audit = perf.save_audit(os.path.join(pfdir, "current.json"))
+labels = set(audit["executables"])
+assert "train_step:PerfLeNet" in labels, labels
+assert "hybrid:ResNetV1" in labels, labels
+print("perflint smoke ok: %d executables audited, %d advisories"
+      % (len(labels), len(audit["advisories"])))
+EOF
+    # gate: efficiency metrics vs the committed baseline -- a grown
+    # transpose/unfused/pad-waste share or an unblessed advisory exits
+    # 1 naming executable + kind; improvements pass
+    python -m mxnet_tpu.analysis --perf-diff \
+        ci/perf_baseline.json "$pfdir/current.json" --json
+    rm -rf "$pfdir"
 }
 
 run_shardlint() {

@@ -47,9 +47,10 @@ def main():
 
     mx.random.seed(0)
     net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu",
+                            layout="NCHW"),
             gluon.nn.BatchNorm(),
-            gluon.nn.MaxPool2D(2),
+            gluon.nn.MaxPool2D(2, layout="NCHW"),
             gluon.nn.Flatten(),
             gluon.nn.Dense(64, activation="relu"),
             gluon.nn.Dense(10))

@@ -19,11 +19,13 @@ import mxnet_tpu as mx                      # noqa: E402
 from mxnet_tpu import autograd, gluon       # noqa: E402
 
 
-def build_net():
+def build_net(layout="NCHW"):
     net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Conv2D(32, kernel_size=3, activation="relu"),
-            gluon.nn.Conv2D(64, kernel_size=3, activation="relu"),
-            gluon.nn.MaxPool2D(2),
+    net.add(gluon.nn.Conv2D(32, kernel_size=3, activation="relu",
+                            layout=layout),
+            gluon.nn.Conv2D(64, kernel_size=3, activation="relu",
+                            layout=layout),
+            gluon.nn.MaxPool2D(2, layout=layout),
             gluon.nn.Flatten(),
             gluon.nn.Dense(128, activation="relu"),
             gluon.nn.Dropout(0.5),

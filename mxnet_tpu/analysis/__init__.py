@@ -46,6 +46,8 @@ from .retrace import audit_retrace
 from .sharding import (audit_sharding, collective_contract,
                        collective_profile, diff_contract, load_contract,
                        save_contract, transfer_guard)
+from .perf import (audit_hlo_text, diff_audit, load_audit, perf_audit,
+                   save_audit)
 from .cli import main
 
 __all__ = [
@@ -56,5 +58,7 @@ __all__ = [
     "audit_lock_order", "static_order_edges", "audit_retrace",
     "audit_sharding", "collective_contract", "collective_profile",
     "diff_contract", "load_contract", "save_contract", "transfer_guard",
+    "audit_hlo_text", "diff_audit", "load_audit", "perf_audit",
+    "save_audit",
     "main",
 ]

@@ -34,8 +34,9 @@ def _build_parser():
         prog="mxlint",
         description="Static graph checker + trace-safety linter + "
                     "concurrency sanitizer + sharding sanitizer + "
-                    "retrace auditor for mxnet_tpu (docs/analysis.md, "
-                    "docs/sharding.md).")
+                    "perf linter + retrace auditor for mxnet_tpu "
+                    "(docs/analysis.md, docs/sharding.md, "
+                    "docs/perf_lint.md).")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint")
     ap.add_argument("--self", dest="self_check", action="store_true",
@@ -71,6 +72,13 @@ def _build_parser():
                          "by analysis.sharding.save_contract) and fail "
                          "on unblessed GSPMD collectives -- the CI "
                          "shardlint gate (docs/sharding.md)")
+    ap.add_argument("--perf-diff", nargs=2,
+                    metavar=("BASELINE", "CURRENT"),
+                    help="diff two perf-audit JSONs (written by "
+                         "analysis.perf.save_audit) and fail on grown "
+                         "transpose/unfused/pad-waste shares or "
+                         "unblessed advisories -- the CI perflint "
+                         "gate (docs/perf_lint.md)")
     ap.add_argument("--disable", default="", metavar="RULES",
                     help="comma-separated rule ids to skip")
     ap.add_argument("--json", dest="as_json", action="store_true",
@@ -149,7 +157,8 @@ def _write_baseline(path, diags: List[Diagnostic]):
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # importing the passes registers their rules
-    from . import concurrency, graph_check, retrace, sharding, trace_lint
+    from . import (concurrency, graph_check, perf, retrace, sharding,
+                   trace_lint)
 
     if args.list_rules:
         print(_list_rules())
@@ -224,8 +233,21 @@ def main(argv=None) -> int:
         diags.extend(d for d in sharding.diff_contract(base, cur)
                      if d.rule not in ignore)
 
+    if args.perf_diff:
+        base_path, cur_path = args.perf_diff
+        try:
+            base = perf.load_audit(base_path)
+            cur = perf.load_audit(cur_path)
+        except (OSError, ValueError, KeyError) as e:
+            print("mxlint: cannot read perf audit: %s" % e,
+                  file=sys.stderr)
+            return 2
+        diags.extend(d for d in perf.diff_audit(base, cur)
+                     if d.rule not in ignore)
+
     if not paths and not args.graph and not run_retrace \
-            and not args.changed and not args.collective_diff:
+            and not args.changed and not args.collective_diff \
+            and not args.perf_diff:
         _build_parser().print_usage()
         return 2
 

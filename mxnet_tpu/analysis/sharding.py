@@ -780,9 +780,9 @@ def collective_contract() -> dict:
     import jax
     from ..profiling import store
     execs: Dict[str, Dict[str, Dict[str, int]]] = {}
-    for label, fn, args in store.executables():
+    for label, compiled in store.compiled_executables():
         try:
-            text = fn.lower(*args).compile().as_text()
+            text = compiled.as_text()
         except Exception:
             continue
         prof = collective_profile(text)

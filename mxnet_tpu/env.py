@@ -235,6 +235,14 @@ _VARS = [
            "least-recently-used program is dropped beyond it (counted "
            "in serving.compile_evictions).  Per-predictor override: "
            "Predictor(jit_cache_size=...)."),
+    EnvVar("MXNET_TPU_PERF_AUDIT_TOL", float, 0.02,
+           "Absolute growth tolerance for the perf auditor's share "
+           "metrics (transpose share, unfused-elementwise share, MXU "
+           "pad waste) when diffing a perf audit against the blessed "
+           "ci/perf_baseline.json (mxlint --perf-diff / "
+           "analysis.perf.diff_audit).  A metric grown past baseline + "
+           "tolerance errors naming the executable; improvements pass "
+           "(docs/perf_lint.md)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

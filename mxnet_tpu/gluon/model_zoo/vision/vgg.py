@@ -1,4 +1,8 @@
-"""VGG (reference: ``gluon/model_zoo/vision/vgg.py``)."""
+"""VGG (reference: ``gluon/model_zoo/vision/vgg.py``).
+
+``layout`` threads end to end (NCHW default, NHWC channels-last) --
+the perflint ``layout-hostile-conv`` contract.
+"""
 from ....base import MXNetError
 from ... import nn
 from ...block import HybridBlock
@@ -13,17 +17,19 @@ vgg_spec = {
 
 class VGG(HybridBlock):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
-                 **kwargs):
+                 layout="NCHW", **kwargs):
         super().__init__(**kwargs)
+        c_axis = layout.index("C")
         with self.name_scope():
             self.features = nn.HybridSequential(prefix="")
             for i, num in enumerate(layers):
                 for _ in range(num):
-                    self.features.add(nn.Conv2D(filters[i], 3, padding=1))
+                    self.features.add(nn.Conv2D(filters[i], 3, padding=1,
+                                                layout=layout))
                     if batch_norm:
-                        self.features.add(nn.BatchNorm())
+                        self.features.add(nn.BatchNorm(axis=c_axis))
                     self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(2, 2))
+                self.features.add(nn.MaxPool2D(2, 2, layout=layout))
             self.features.add(nn.Flatten())
             self.features.add(nn.Dense(4096, activation="relu"))
             self.features.add(nn.Dropout(0.5))

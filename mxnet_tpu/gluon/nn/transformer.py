@@ -295,6 +295,10 @@ class TransformerEncoder(HybridBlock):
         pos = F.slice_axis(position_weight, axis=0, begin=0, end=seq)
         x = x + pos.expand_dims(0)
         x = self.drop(self.ln(x))
-        for cell in self.cells:
+        # each cell carries DISTINCT weights; a scan needs the per-layer
+        # params stacked into one leading-axis pytree (a param-store
+        # refactor, tracked under ROADMAP item 2's BERT work) -- until
+        # then the unroll is deliberate and its compile cost accepted
+        for cell in self.cells:  # mxlint: disable=python-loop-unroll
             x = cell(x, mask)
         return x

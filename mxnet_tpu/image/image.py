@@ -339,6 +339,7 @@ class ImageIter:
         self._pool = None
         self._proc_pool = None
         self._shm = None
+        self._main_file_restore = None
         self._n_procs = int(preprocess_procs or 0)
         if self._n_procs == 0 and preprocess_threads and \
                 preprocess_threads > 1:
@@ -398,16 +399,19 @@ class ImageIter:
         # the bogus path '<stdin>', which makes every worker crash on
         # import and the pool respawn forever (a hang, not an error).
         # The workers only need _pool_worker_init from THIS importable
-        # module, so drop the unloadable __file__ -- permanently, not
-        # just for the initial spawn: the Pool's maintenance thread
-        # respawns dead workers later, and a restored bogus path would
-        # resurrect the hang then.  A path that doesn't exist can never
-        # be loaded by anyone, so removing it loses nothing.
+        # module, so drop the unloadable __file__ for the POOL'S
+        # LIFETIME -- the Pool's maintenance thread respawns dead
+        # workers later, so the attr must stay gone while the pool
+        # lives -- and restore it in close() once terminate()+join()
+        # make respawns impossible.  Mutating __main__ forever was a
+        # process-global side effect other tooling could observe
+        # (ADVICE round-5 low).
         import sys as _sys
         main_mod = _sys.modules.get("__main__")
         main_file = getattr(main_mod, "__file__", None)
         if main_file is not None and not os.path.exists(main_file):
             del main_mod.__file__
+            self._main_file_restore = (main_mod, main_file)
         self._proc_pool = ctx.Pool(
             self._n_procs, initializer=_pool_worker_init,
             initargs=(idx_path, path_imgrec, self._shm.name,
@@ -428,6 +432,14 @@ class ImageIter:
             self._proc_pool.terminate()
             self._proc_pool.join()
             self._proc_pool = None
+        if self._main_file_restore is not None:
+            # the pool is dead (terminate+join above): no maintenance
+            # thread can respawn a worker, so the spawn workaround ends
+            # here and __main__ goes back exactly as found
+            mod, path = self._main_file_restore
+            if not hasattr(mod, "__file__"):
+                mod.__file__ = path
+            self._main_file_restore = None
         if self._shm is not None:
             self._slab = None
             try:

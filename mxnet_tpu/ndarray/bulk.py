@@ -171,6 +171,15 @@ def _in_descr(flat):
     return tuple(_leaf_descr(x) for x in flat)
 
 
+def _stale(x):
+    """A pending input unusable as a slot wire: it failed in a prior
+    flush (must re-raise ITS error, not wire a stale slot index into
+    this region) or it belongs to a region another thread swapped out
+    and is executing."""
+    return (isinstance(x, LazyData) and x._concrete is None
+            and (x._error is not None or x._region is not _cur_region))
+
+
 def enqueue(fnc, key_tag, args, device=None):
     """Append a call of ``fnc(*args)`` to the pending region and return
     its outputs as a pytree of LazyData.  ``key_tag`` must uniquely and
@@ -180,86 +189,99 @@ def enqueue(fnc, key_tag, args, device=None):
     when output avals for this (key_tag, input-aval) pair are not known
     yet -- the warmup call doubles as the aval probe.
     """
-    with _LOCK:
-        flat, treedef = jax.tree_util.tree_flatten(args)
-        descr = _in_descr(flat)
-        aval_key = (key_tag, descr)
-        cached = _AVAL_CACHE.get(aval_key)
-        if cached is None:
-            # warmup: run now (also compiles fnc) and record output avals
-            out = fnc(*_resolve_args(args))
-            oflat, otree = jax.tree_util.tree_flatten(out)
-            _cache_put(_AVAL_CACHE, aval_key,
-                       (otree, [(tuple(o.shape), o.dtype) for o in oflat]))
-            return out
-
-        # a pending input may be unusable as a slot wire: it failed in a
-        # prior flush (must re-raise ITS error, not wire a stale slot
-        # index into this region) or it belongs to a region another
-        # thread swapped out and is executing.  Resolve those up front
-        # -- materialize waits/raises as appropriate.  This runs BEFORE
-        # the device-token logic because it can flush (resetting the
-        # region state the token check reads).
-        def _stale(x):
-            return (isinstance(x, LazyData) and x._concrete is None
-                    and (x._error is not None
-                         or x._region is not _cur_region))
+    flat, treedef = jax.tree_util.tree_flatten(args)
+    while True:
+        # Stale inputs are materialized OUTSIDE the global lock:
+        # materialize may wait on another region's in-flight execution
+        # (its ``done`` event) or replay a failed region, and doing
+        # that under _LOCK would serialize every thread's eager
+        # dispatch behind one region's device time.  The scan retries
+        # under the lock below -- a flush racing between the two scans
+        # can only mint NEW stale entries, which the retry resolves.
         if any(_stale(x) for x in flat):
             flat = [x.materialize() if _stale(x) else x for x in flat]
-
-        # one region = one device: a pending region whose leaves span
-        # devices cannot execute as a single jitted program
-        global _region_dev
-        tok = None
-        if device is not None:
-            tok = (device,)
-        else:
-            for x in flat:
-                if isinstance(x, jax.Array):
-                    tok = tuple(sorted(x.devices(), key=lambda d: d.id))
-                    break
-                if isinstance(x, LazyData) and x._concrete is None \
-                        and x.device is not None:
-                    tok = (x.device,)
-                    break
-        if _entries and tok is not None and _region_dev is not None \
-                and tok != _region_dev:
-            flush()
-        if tok is not None and not _entries:
-            _region_dev = tok
-
-        out_treedef, out_avals = cached
-        markers = []
-        for x in flat:
-            if isinstance(x, LazyData) and x._concrete is None:
-                markers.append(("slot", x.slot))
-                if device is None:
-                    device = x.device
-            else:
-                if isinstance(x, LazyData):
-                    x = x._concrete
-                markers.append(("leaf", len(_leaf_vals)))
-                _leaf_vals.append(x)
-        out_slots = []
-        outs = []
-        for shape, dtype in out_avals:
-            slot = len(_pending)
-            ld = LazyData(shape, dtype, slot, device=device,
-                          region=_cur_region)
-            _pending.append(ld)
-            out_slots.append(slot)
-            outs.append(ld)
-        _entries.append((fnc, treedef, tuple(markers), tuple(out_slots),
-                         out_treedef))
-        _key_parts.append((key_tag, treedef, tuple(markers), descr))
-        need_flush = len(_entries) >= _MAX_PENDING
-        result = jax.tree_util.tree_unflatten(out_treedef, outs)
+        with _LOCK:
+            if any(_stale(x) for x in flat):
+                continue               # a racing flush; resolve again
+            result, need_flush = _enqueue_locked(fnc, key_tag, flat,
+                                                 treedef, device)
+            break
     # the capacity flush (the NORMAL flush trigger for long loops) runs
     # outside the lock so its region execution doesn't serialize other
     # threads' eager dispatch
     if need_flush:
         flush()
     return result
+
+
+def _enqueue_locked(fnc, key_tag, flat, treedef, device):
+    """Wire one call into the current region; caller holds ``_LOCK``
+    and has resolved every stale input."""
+    # resolved LazyData are plain concrete leaves from here on; the
+    # region-key descr is computed AFTER that normalization, so a
+    # replayed region keys identically whether an input arrived
+    # concrete or as an already-resolved placeholder
+    flat = [x._concrete if isinstance(x, LazyData)
+            and x._concrete is not None else x for x in flat]
+    descr = _in_descr(flat)
+    aval_key = (key_tag, descr)
+    cached = _AVAL_CACHE.get(aval_key)
+    if cached is None:
+        # warmup: run now (also compiles fnc) and record output avals;
+        # remaining LazyData belong to the current region and resolve
+        # via the recursive flush (RLock)
+        args = jax.tree_util.tree_unflatten(treedef, flat)
+        out = fnc(*_resolve_args(args))
+        oflat, otree = jax.tree_util.tree_flatten(out)
+        _cache_put(_AVAL_CACHE, aval_key,
+                   (otree, [(tuple(o.shape), o.dtype) for o in oflat]))
+        return out, False
+
+    # one region = one device: a pending region whose leaves span
+    # devices cannot execute as a single jitted program
+    global _region_dev
+    tok = None
+    if device is not None:
+        tok = (device,)
+    else:
+        for x in flat:
+            if isinstance(x, jax.Array):
+                tok = tuple(sorted(x.devices(), key=lambda d: d.id))
+                break
+            if isinstance(x, LazyData) and x._concrete is None \
+                    and x.device is not None:
+                tok = (x.device,)
+                break
+    if _entries and tok is not None and _region_dev is not None \
+            and tok != _region_dev:
+        flush()
+    if tok is not None and not _entries:
+        _region_dev = tok
+
+    out_treedef, out_avals = cached
+    markers = []
+    for x in flat:
+        if isinstance(x, LazyData) and x._concrete is None:
+            markers.append(("slot", x.slot))
+            if device is None:
+                device = x.device
+        else:
+            markers.append(("leaf", len(_leaf_vals)))
+            _leaf_vals.append(x)
+    out_slots = []
+    outs = []
+    for shape, dtype in out_avals:
+        slot = len(_pending)
+        ld = LazyData(shape, dtype, slot, device=device,
+                      region=_cur_region)
+        _pending.append(ld)
+        out_slots.append(slot)
+        outs.append(ld)
+    _entries.append((fnc, treedef, tuple(markers), tuple(out_slots),
+                     out_treedef))
+    _key_parts.append((key_tag, treedef, tuple(markers), descr))
+    need_flush = len(_entries) >= _MAX_PENDING
+    return jax.tree_util.tree_unflatten(out_treedef, outs), need_flush
 
 
 def _resolve_args(args):

@@ -66,6 +66,21 @@ def executables():
         return list(_live.values())
 
 
+def compiled_executables():
+    """``(label, jax.stages.Compiled)`` for every registered
+    executable, lowered at call time (hits jax's executable cache for
+    anything already dispatched).  The shared audit surface: the
+    sharding sanitizer's collective contract and the perf auditor
+    (``analysis.perf.perf_audit``) both walk this instead of lowering
+    independently.  Entries whose lowering fails (args gone stale) are
+    skipped."""
+    for label, fn, args in executables():
+        try:
+            yield label, fn.lower(*args).compile()
+        except Exception:
+            continue
+
+
 def record_step(label, seconds, items=None):
     seconds = float(seconds)
     with _lock:
