@@ -83,6 +83,141 @@ def _persist_cost_report(tag, step, step_time_s=None,
         return None
 
 
+# r05 shipped on a collapsed tunnel (dispatch RTT ~90ms vs ~2ms
+# healthy) and its headline read as a perf regression until the
+# env_health line was cross-checked by hand.  Every emitted JSONL line
+# now carries `degraded_env`, derived ONCE from the health probe, so a
+# tunnel collapse can never again be read as a model regression.
+_DEGRADED_RTT_US = 10000.0
+_ENV_DEGRADED = {"flag": None}     # None until the health probe ran
+
+
+def _mark_env_health(health):
+    """Derive the degraded-environment flag from the env_health probe
+    (dispatch_roundtrip threshold); returns the flag for the line."""
+    rtt = health.get("dispatch_roundtrip_us")
+    _ENV_DEGRADED["flag"] = bool(rtt is not None
+                                 and rtt > _DEGRADED_RTT_US)
+    return _ENV_DEGRADED["flag"]
+
+
+# ----------------------------------------------------------------------
+# kernel-tier before/after HLO diff (ISSUE 11): the resnet50-scan and
+# BERT-flash lines carry per-category compiled-HLO byte deltas of the
+# SAME probe model built with the Pallas kernel tier off vs armed --
+# the `mxprof diff` of the kernel tier, riding the JSONL line itself.
+# ----------------------------------------------------------------------
+
+def _hlo_category_bytes(step):
+    """Category byte counters of a TrainStep's most recent compiled
+    program (analysis.perf.audit_hlo_text over the compiled HLO)."""
+    from mxnet_tpu.analysis.perf import audit_hlo_text
+    fn, arg_shapes = step._last_call
+    text = fn.lower(*arg_shapes).compile().as_text()
+    c = audit_hlo_text(text)
+    out = {k: int(v) for k, v in c["category_bytes"].items()}
+    out["unfused_elementwise"] = int(c["unfused_elementwise_bytes"])
+    out["bytes_total"] = int(c["bytes_total"])
+    return out
+
+
+def _kernels_probe_step(model):
+    """Compile one small fwd+bwd+update step of the probe model under
+    the CURRENT kernel-tier mode and return the TrainStep.  NHWC +
+    LARS for the resnet probe (the fused BN+ReLU sites and the
+    bucket-flattened optimizer both engage); a small flash BERT for
+    the attention probe."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import TrainStep
+    ctx = _ctx()
+    rng = np.random.RandomState(0)
+    if model == "resnet":
+        from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+        net = resnet18_v1(classes=10, thumbnail=True, layout="NHWC")
+        net.initialize(ctx=ctx)
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "lars",
+                                {"learning_rate": 0.1}, kvstore=None)
+        step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         trainer, mesh=None)
+        x = mx.nd.array(rng.rand(2, 32, 32, 3).astype(np.float32),
+                        ctx=ctx)
+        y = mx.nd.array(rng.randint(0, 10, (2,)).astype(np.float32),
+                        ctx=ctx)
+    else:                             # "bert": the flash-attention probe
+        vocab = 512
+        net = gluon.model_zoo.bert_small(vocab_size=vocab,
+                                         max_length=256, dropout=0.0)
+        net.initialize(ctx=ctx)
+        net.hybridize()
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        class _MLM(gluon.HybridBlock):
+            def hybrid_forward(self, F, outs, labels):
+                mlm, _nsp = outs
+                return ce(mlm.reshape((-1, vocab)),
+                          labels.reshape((-1,)))
+
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-4}, kvstore=None)
+        step = TrainStep(net, _MLM(), trainer, mesh=None)
+        x = mx.nd.array(rng.randint(0, vocab, (1, 256))
+                        .astype(np.float32), ctx=ctx)
+        y = mx.nd.array(rng.randint(0, vocab, (1, 256))
+                        .astype(np.float32), ctx=ctx)
+    step(x, y)
+    return step
+
+
+def _kernels_diff(model):
+    """Before/after category bytes of the probe model's compiled step:
+    kernel tier off (MXNET_TPU_KERNELS=0) vs armed (=1).  Returns the
+    {probe, before, after, delta} dict the JSONL line carries, or None
+    when pallas is unavailable."""
+    from mxnet_tpu import kernels as _k
+    if not _k.available():
+        return None
+    saved = _os.environ.get("MXNET_TPU_KERNELS")
+    try:
+        _os.environ["MXNET_TPU_KERNELS"] = "0"
+        before = _hlo_category_bytes(_kernels_probe_step(model))
+        _os.environ["MXNET_TPU_KERNELS"] = "1"
+        after = _hlo_category_bytes(_kernels_probe_step(model))
+    finally:
+        if saved is None:
+            _os.environ.pop("MXNET_TPU_KERNELS", None)
+        else:
+            _os.environ["MXNET_TPU_KERNELS"] = saved
+    keys = sorted(set(before) | set(after))
+    import jax
+    interp = jax.default_backend() != "tpu"
+    return {
+        "probe": ("resnet18v1-nhwc-lars-b2-32x32" if model == "resnet"
+                  else "bert_small-flash-b1-seq256"),
+        # on a non-TPU backend the 'after' program is the INTERPRET-
+        # mode lowering of the kernels (correctness only -- its byte
+        # counts are not a perf statement); on TPU it is the real
+        # Mosaic program and the deltas are the kernel tier's win
+        "after_interpret": interp,
+        "before": before,
+        "after": after,
+        "delta": {k: after.get(k, 0) - before.get(k, 0) for k in keys},
+    }
+
+
+def _kernels_diff_extra(model, est_s=240):
+    """extra_fn fields: the kernel-tier HLO diff, budget-gated and
+    never fatal to the line that carries it."""
+    if _remaining() < est_s:
+        return {}
+    try:
+        diff = _kernels_diff(model)
+    except Exception as e:
+        return {"kernels_diff_error": str(e)[:120]}
+    return {"kernels_diff": diff} if diff else {}
+
+
 def _cost_extra(tag):
     """extra_fn fields for the emitted JSONL line: artifact path plus
     the top category + its roofline bound, so the line itself says
@@ -909,6 +1044,13 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
     return count / dt, round(overlap, 3)
 
 
+
+def _print_line(rec):
+    """Emit one JSONL record carrying the degraded-environment flag
+    (bench-hygiene contract: no emitted measurement without it)."""
+    rec.setdefault("degraded_env", _ENV_DEGRADED["flag"])
+    print(json.dumps(rec))
+
 def _emit_with_retry(metric, fn, attempts=2, unit="tokens/s",
                      extra=None, extra_fn=None):
     """Run fn() with retries (the tunneled compile service can drop a
@@ -919,7 +1061,8 @@ def _emit_with_retry(metric, fn, attempts=2, unit="tokens/s",
         try:
             val = fn()
             rec = {"metric": metric, "value": round(val, 1), "unit": unit,
-                   "vs_baseline": None}
+                   "vs_baseline": None,
+                   "degraded_env": _ENV_DEGRADED["flag"]}
             if extra:
                 rec.update(extra)
             if extra_fn is not None:
@@ -929,7 +1072,8 @@ def _emit_with_retry(metric, fn, attempts=2, unit="tokens/s",
         except Exception as e:
             if attempt == attempts - 1:
                 print(json.dumps({"metric": metric,
-                                  "error": str(e)[:200]}))
+                                  "error": str(e)[:200],
+                                  "degraded_env": _ENV_DEGRADED["flag"]}))
             else:
                 time.sleep(5)
     return None
@@ -953,10 +1097,12 @@ def main():
     # -- 0: environment health (fresh process, before any compute) ----
     try:
         health = bench_env_health(h2d_mb=64 if on_tpu else 8)
-        health.update({"metric": "env_health", "budget_s": _BUDGET_S})
+        health.update({"metric": "env_health", "budget_s": _BUDGET_S,
+                       "degraded_env": _mark_env_health(health)})
         print(json.dumps(health))
     except Exception as e:
-        print(json.dumps({"metric": "env_health", "error": str(e)[:200]}))
+        print(json.dumps({"metric": "env_health", "error": str(e)[:200],
+                          "degraded_env": None}))
 
     # -- 1: headline ResNet (compiled K-step loop, bf16, dispersion) --
     rn_scan = None
@@ -976,11 +1122,12 @@ def main():
                           "min": min(rn_out.get("wins") or [0]),
                           "max": max(rn_out.get("wins") or [0]),
                           "windows": rn_out.get("wins"),
-                          **_cost_extra("resnet50_bf16")})
+                          **_cost_extra("resnet50_bf16"),
+                          **_kernels_diff_extra("resnet")})
 
     # -- 2: headline BERT (bs=256 is the single-chip knee, r4) --------
     def _emit_bert(metric, bs, seq, dt_name, iters, windows=1,
-                   attempts=2):
+                   attempts=2, kernels_probe=False):
         out = {}
 
         def run():
@@ -998,6 +1145,8 @@ def main():
                 rec.update({"min": min(out["wins"]),
                             "max": max(out["wins"]),
                             "windows": out["wins"]})
+            if kernels_probe:
+                rec.update(_kernels_diff_extra("bert"))
             return rec
         return _emit_with_retry(metric, run, attempts=attempts,
                                 extra_fn=extra)
@@ -1024,7 +1173,8 @@ def main():
                       "value": round(headline, 1) if headline else None,
                       "unit": "img/s",
                       "vs_baseline": round(headline / baseline, 4)
-                      if headline else None}))
+                      if headline else None,
+                      "degraded_env": _ENV_DEGRADED["flag"]}))
 
     # -- garnish (budget-gated; order = value per second) -------------
     # BASELINE config 5: bf16 AMP + LARS large-batch (the last named
@@ -1053,12 +1203,12 @@ def main():
     if _budget_ok("multichip_scaling", 240):
         try:
             rows = _multichip_scaling_rows()
-            print(json.dumps({"metric": "multichip_scaling",
-                              "unit": "img/s", "scaling": rows,
-                              "vs_baseline": None}))
+            _print_line({"metric": "multichip_scaling",
+                         "unit": "img/s", "scaling": rows,
+                         "vs_baseline": None})
         except Exception as e:
-            print(json.dumps({"metric": "multichip_scaling",
-                              "error": str(e)[:200]}))
+            _print_line({"metric": "multichip_scaling",
+                         "error": str(e)[:200]})
 
     # serving tier: latency-vs-QPS curve (ISSUE 8 bench contract)
     if _budget_ok("serving_latency_qps", 120):
@@ -1067,12 +1217,12 @@ def main():
                 offered_qps=(100, 400, 1600) if on_tpu else (50, 200),
                 duration_s=2.0 if on_tpu else 1.0,
                 clients=8 if on_tpu else 4)
-            print(json.dumps({"metric": "serving_latency_qps",
-                              "curve": curve, "unit": "qps/ms",
-                              "vs_baseline": None}))
+            _print_line({"metric": "serving_latency_qps",
+                         "curve": curve, "unit": "qps/ms",
+                         "vs_baseline": None})
         except Exception as e:
-            print(json.dumps({"metric": "serving_latency_qps",
-                              "error": str(e)[:200]}))
+            _print_line({"metric": "serving_latency_qps",
+                         "error": str(e)[:200]})
 
     if _budget_ok("lenet_mnist_train", 120):
         _emit_with_retry("lenet_mnist_train",
@@ -1101,16 +1251,16 @@ def main():
             val = _cpu_subprocess_value(
                 "bench.bench_lenet_imperative(64, iters=20)")
             val2 = _cpu_subprocess_value("bench.bench_lenet(64)")
-            print(json.dumps({"metric":
-                              "lenet_imperative_local_dispatch_cpu",
-                              "value": round(val, 1), "unit": "img/s",
-                              "vs_baseline": None,
-                              "hybridized_local_cpu": round(val2, 1),
-                              "imperative_over_hybridized":
-                              round(val / val2, 3)}))
+            _print_line({"metric":
+                         "lenet_imperative_local_dispatch_cpu",
+                         "value": round(val, 1), "unit": "img/s",
+                         "vs_baseline": None,
+                         "hybridized_local_cpu": round(val2, 1),
+                         "imperative_over_hybridized":
+                         round(val / val2, 3)})
         except Exception as e:
-            print(json.dumps({"metric": "lenet_imperative_local_dispatch",
-                              "error": str(e)[:200]}))
+            _print_line({"metric": "lenet_imperative_local_dispatch",
+                         "error": str(e)[:200]})
 
     if _budget_ok("resnet50_imagenet_train_fp32", 180):
         _emit_with_retry("resnet50_imagenet_train_fp32",
@@ -1121,19 +1271,19 @@ def main():
         try:
             jpeg_ips, raw_ips, scaling = bench_pipeline(
                 n=512 if on_tpu else 128, threads=2)
-            print(json.dumps({"metric": "pipeline_jpeg_decode",
-                              "value": round(jpeg_ips, 1),
-                              "unit": "img/s/host",
-                              "host_cores": _os.cpu_count(),
-                              "scaling": scaling,
-                              "vs_baseline": None}))
-            print(json.dumps({"metric": "pipeline_raw_uint8",
-                              "value": round(raw_ips, 1),
-                              "unit": "img/s/host",
-                              "host_cores": _os.cpu_count(),
-                              "vs_baseline": None}))
+            _print_line({"metric": "pipeline_jpeg_decode",
+                         "value": round(jpeg_ips, 1),
+                         "unit": "img/s/host",
+                         "host_cores": _os.cpu_count(),
+                         "scaling": scaling,
+                         "vs_baseline": None})
+            _print_line({"metric": "pipeline_raw_uint8",
+                         "value": round(raw_ips, 1),
+                         "unit": "img/s/host",
+                         "host_cores": _os.cpu_count(),
+                         "vs_baseline": None})
         except Exception as e:
-            print(json.dumps({"metric": "pipeline", "error": str(e)[:200]}))
+            _print_line({"metric": "pipeline", "error": str(e)[:200]})
 
     if on_tpu and _budget_ok("resnet50_imagenet_train_e2e_bf16", 600):
         try:
@@ -1143,13 +1293,13 @@ def main():
                 "bench.bench_resnet50_e2e(%d, dtype='bfloat16')"
                 % (rn_bs * 2),
                 timeout=max(300, min(900, int(_remaining()) - 60)))
-            print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
-                              "value": round(e2e, 1), "unit": "img/s",
-                              "staging_overlap_frac": overlap,
-                              "vs_baseline": None}))
+            _print_line({"metric": "resnet50_imagenet_train_e2e_bf16",
+                         "value": round(e2e, 1), "unit": "img/s",
+                         "staging_overlap_frac": overlap,
+                         "vs_baseline": None})
         except Exception as e:
-            print(json.dumps({"metric": "resnet50_imagenet_train_e2e_bf16",
-                              "error": str(e)[:200]}))
+            _print_line({"metric": "resnet50_imagenet_train_e2e_bf16",
+                         "error": str(e)[:200]})
 
     if on_tpu:
         # seq sweep: captures the XLA/Pallas crossover in the artifact
@@ -1160,9 +1310,11 @@ def main():
                        "bfloat16", 10, attempts=1)
         if _budget_ok("bert_base_pretrain_seq1024_bf16_flash", 600):
             # long-context config: seq 1024 is where the Pallas flash
-            # fwd+bwd kernels pull away from XLA (81k vs 60k tok/s, r3)
+            # fwd+bwd kernels pull away from XLA (81k vs 60k tok/s, r3);
+            # the line carries the kernel-tier before/after HLO diff
             _emit_bert("bert_base_pretrain_seq1024_bf16_flash", 16,
-                       1024, "bfloat16", 10, attempts=1)
+                       1024, "bfloat16", 10, attempts=1,
+                       kernels_probe=True)
 
     print(json.dumps({"metric": "bench_complete",
                       "elapsed_s": round(time.monotonic() - _T_START, 1),

@@ -52,6 +52,17 @@
 #                with the steady-state steps run under
 #                transfer_guard("disallow") and a seeded implicit
 #                host transfer proven to raise
+#   kernels -> Pallas kernel tier gates (docs/kernels.md): the
+#              interpret-mode kernel tests (registry policy, fused
+#              BN+ReLU numerics+vjp, flash op-level pallas path incl.
+#              the masked backward, bucket-flattened LARS/LAMB), an
+#              explicit fallback proof (Pallas monkeypatched away ->
+#              every choice lands on XLA, numerics intact), then a
+#              kernels-armed smoke train (NHWC BN+ReLU fusion sites +
+#              bucketed LARS through one compiled TrainStep, kernels
+#              in interpret mode on CPU) whose perf audit must show
+#              zero drift against the blessed train_step:KernelSmokeNet
+#              row of ci/perf_baseline.json (mxlint --perf-diff)
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -60,7 +71,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint spmd serving bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -629,6 +640,106 @@ print("serving gate ok: %d requests, occupancy %.2f, p99 %.1fms"
       % (sv["requests"], sv["mean_occupancy"], 1e3 * sv["latency_p99_s"]))
 EOF
     rm -rf "$svjsonl" "$svjsonl.agg" "$svcache"
+}
+
+run_kernels() {
+    log "kernels: interpret-mode kernel tests (registry + numerics + vjp + fallback)"
+    # tests arm MXNET_TPU_KERNELS themselves (fixtures) so the CPU
+    # backend runs the REAL Pallas kernel bodies in interpret mode
+    JAX_PLATFORMS=cpu python -m pytest tests/test_kernels.py \
+        tests/test_flash_attention.py -q -m 'not slow'
+    log "kernels: fallback proof (Pallas unavailable -> XLA, numerics intact)"
+    JAX_PLATFORMS=cpu MXNET_TPU_KERNELS=1 python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+from mxnet_tpu import kernels
+from mxnet_tpu.kernels import fused_bn_relu as fbr
+from mxnet_tpu.kernels import registry as kreg
+
+# simulate a build without pallas: every choice must land on XLA
+kreg._has_pallas = lambda: False
+for name, kw in (("flash_attention",
+                  dict(seq=512, block_q=256, block_k=256)),
+                 ("fused_bn_relu", dict(axis=3, ndim=4)),
+                 ("bucket_optimizer", {})):
+    ch = kernels.choose(name, force=True, **kw)
+    assert not ch.use_pallas, (name, ch)
+    assert "unavailable" in ch.reason, ch.reason
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(2, 4, 4, 8).astype(np.float32))
+g = jnp.asarray(rng.rand(8).astype(np.float32) + 0.5)
+b = jnp.asarray(rng.randn(8).astype(np.float32))
+mm, mv = jnp.zeros(8, jnp.float32), jnp.ones(8, jnp.float32)
+out, _, _ = fbr.fused_bn_relu(x, g, b, mm, mv, fix_gamma=False,
+                              axis=3, training=True)
+ro, _, _ = fbr.xla_reference(x, g, b, mm, mv, fix_gamma=False,
+                             axis=3, training=True)
+np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                           rtol=1e-6, atol=1e-6)
+print("fallback proof ok: 3 kernels decline, fused op == XLA reference")
+EOF
+    log "kernels: zero-drift perf audit with the kernel tier armed"
+    kdir=$(mktemp -d /tmp/mxtpu_kernels_ci.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_KERNELS=1 MXNET_TPU_PROFILING=1 \
+        python - "$kdir" <<'EOF'
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, kernels, profiling
+from mxnet_tpu.analysis import perf
+from mxnet_tpu.parallel import TrainStep
+
+kdir = sys.argv[1]
+assert profiling.enabled(), "MXNET_TPU_PROFILING=1 did not arm capture"
+assert kernels.mode() == "on", "MXNET_TPU_KERNELS=1 did not arm the tier"
+assert mx.runtime.Features().is_enabled("KERNELS")
+
+
+class KernelSmokeNet(gluon.nn.HybridSequential):
+    """Named so the kernels-armed audit row is stable across CI runs."""
+
+
+net = KernelSmokeNet()
+net.add(gluon.nn.Conv2D(8, 3, padding=1, layout="NHWC"),
+        gluon.nn.BatchNorm(axis=3),
+        gluon.nn.Activation("relu"),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "lars", {"learning_rate": 0.1},
+                   kvstore=None)
+step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr,
+                 mesh=None)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(8, 12, 12, 1).astype(np.float32))
+y = mx.nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
+for _ in range(2):                      # fused BN+ReLU + bucketed LARS
+    loss = step(x, y)
+loss.asnumpy()
+# the compiled step really selected the kernels (interpret on CPU)
+assert kernels.choose("fused_bn_relu", axis=3, ndim=4).use_pallas
+from mxnet_tpu.kernels import optimizer_update as kopt
+assert kopt.bucket_active(tr._optimizer)
+# audit scoped to the kernels-armed executable: the eager/hybrid op
+# labels belong to the perflint smoke's blessed rows
+audit = perf.perf_audit()
+label = "train_step:KernelSmokeNet"
+assert label in audit["executables"], audit["executables"].keys()
+audit["executables"] = {label: audit["executables"][label]}
+audit["advisories"] = [a for a in audit["advisories"]
+                       if a.get("executable") == label]
+perf.save_audit(os.path.join(kdir, "current.json"), audit)
+print("kernels smoke ok: %s audited (%d advisories)"
+      % (label, len(audit["advisories"])))
+EOF
+    # gate: the kernels-armed executable's efficiency metrics vs the
+    # blessed train_step:KernelSmokeNet row -- growth errors naming the
+    # executable + kind (with the remedy kernel), improvements pass
+    python -m mxnet_tpu.analysis --perf-diff \
+        ci/perf_baseline.json "$kdir/current.json" --json
+    rm -rf "$kdir"
 }
 
 run_bench() {

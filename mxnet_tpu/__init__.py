@@ -137,6 +137,8 @@ from . import module
 from . import module as mod
 from . import profiler
 from . import profiling
+from . import kernels
+from . import bucketing
 from . import runtime
 from .distributed import distributed_init
 from . import numpy as np

@@ -310,28 +310,25 @@ def _as_host(x):
 
 
 def _bucketed(arrays, one_collective):
-    """Shared flatten/concat/split machinery: group ``arrays`` by dtype,
-    run ``one_collective(buffer, ntensors)`` once per group, and return
-    the per-input results placed back on each input's sharding."""
+    """Flatten/concat/split machinery: group ``arrays`` by dtype, run
+    ``one_collective(buffer, ntensors)`` once per group, and return the
+    per-input results placed back on each input's sharding.  The
+    grouping itself is the shared ``mxnet_tpu.bucketing`` helper -- the
+    same logic the fused bucket-flattened optimizer update compiles
+    over traced buffers (docs/kernels.md)."""
     import numpy as np
+    from .bucketing import dtype_groups, flatten_group, split_group
     arrays = list(arrays)
     if not arrays:
         return []
     placements = [_result_device(getattr(a, "_data", a)) for a in arrays]
     hosts = [_as_host(a) for a in arrays]
-    groups = {}                          # dtype -> [index, ...]
-    for i, h in enumerate(hosts):
-        groups.setdefault(h.dtype, []).append(i)
     out = [None] * len(arrays)
-    for dtype, idxs in groups.items():
-        flat = [hosts[i].ravel() for i in idxs]
-        buf = np.concatenate(flat) if len(flat) > 1 else flat[0]
+    for _dtype, idxs in dtype_groups(hosts):
+        buf = flatten_group(hosts, idxs, np)
         res = np.asarray(one_collective(buf, len(idxs)))
-        off = 0
-        for i in idxs:
-            n = hosts[i].size
-            piece = res[off:off + n].reshape(hosts[i].shape)
-            off += n
+        pieces = split_group(res, [hosts[i].shape for i in idxs])
+        for i, piece in zip(idxs, pieces):
             out[i] = _place(piece, placements[i])
     return out
 

@@ -1,0 +1,29 @@
+"""``mxnet_tpu.kernels`` -- the Pallas custom-kernel tier.
+
+A registry of hand-written Pallas TPU kernels with automatic XLA
+fallback (docs/kernels.md).  Three kernels ship through it:
+
+- ``fused_bn_relu``: NHWC-native fused BatchNorm+ReLU (training
+  forward AND backward; bf16 activations, fp32 batch statistics),
+  wired into the gluon ``HybridSequential`` BatchNorm+Activation
+  fusion sites behind ``MXNET_TPU_KERNELS=1``.
+- ``flash_attention``: the blockwise online-softmax attention kernels
+  (``ops/pallas/flash_attention.py``), promoted out of ad-hoc
+  ``use_pallas`` branches into ONE registry selection point.
+- ``bucket_optimizer``: LARS/LAMB trust-ratio + momentum update over
+  one concatenated per-dtype buffer (shared ``mxnet_tpu.bucketing``
+  grouping), replacing the per-parameter elementwise-kernel swarm in
+  the compiled train step.
+
+Selection policy (``registry.choose``): ``MXNET_TPU_KERNELS`` unset =
+auto (Pallas only where measured profitable, on TPU), ``1`` = forced
+(interpret mode on CPU so tier-1 exercises the real kernel bodies),
+``0`` = XLA everywhere.
+"""
+from .registry import (KernelChoice, KernelSpec, available, choose,
+                       describe, enabled, get, list_kernels, mode,
+                       register_kernel, remedy_for)
+
+__all__ = ["KernelChoice", "KernelSpec", "available", "choose",
+           "describe", "enabled", "get", "list_kernels", "mode",
+           "register_kernel", "remedy_for"]

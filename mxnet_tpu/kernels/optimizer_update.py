@@ -1,0 +1,438 @@
+"""Bucket-flattened optimizer update (Pallas/TPU): LARS/LAMB trust
+ratios + momentum over ONE concatenated per-dtype buffer.
+
+The compiled train step used to dispatch one ``lars_update`` /
+``lamb_update_phase1/2`` program fragment PER PARAMETER -- for
+ResNet-50 that is ~160 tiny elementwise kernels per step (the
+"per-parameter elementwise-kernel swarm" PR 10's audit flags as
+top-level unfused-elementwise traffic).  Here the parameter set is
+grouped by dtype with the shared ``mxnet_tpu.bucketing`` helper (the
+same grouping the PR-9 host collectives use), each group's weights/
+grads/momenta flatten into one contiguous buffer, per-tensor trust
+ratios compute as small fused reductions, and the elementwise update
+runs as ONE pass over the flat buffer -- a Pallas VMEM kernel when the
+registry selects it, the identical jnp math otherwise.
+
+Per-tensor semantics are preserved exactly: LARS trust ratios (and the
+skip-list's plain-momentum path, including its opposite momentum sign
+convention, so checkpointed state stays interchangeable with the eager
+per-parameter updates) and LAMB's bias correction + r1/r2 trust bounds
+all ride per-element vectors expanded from per-tensor scalars.
+
+Custom-vjp backward: the flat updates are ``jax.custom_vjp`` functions
+whose backward replays the XLA math through ``jax.vjp`` (the
+layernorm-kernel pattern) -- differentiable for meta-learning uses,
+with the trust ratio treated as part of the per-element ``lr`` input.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..bucketing import dtype_groups, flatten_group, split_group
+from .registry import KernelSpec, choose, mode, register_kernel
+
+try:  # pallas import kept lazy-safe: CPU-only builds fall back to XLA
+    from jax.experimental import pallas as pl
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+LANE = 128
+
+
+def _pad2d(v, lane=LANE):
+    """Flat (n,) -> (rows, lane) zero-padded, for the 2-D tiling the
+    TPU vector memory wants."""
+    n = v.shape[0]
+    rows = -(-n // lane)
+    pad = rows * lane - n
+    if pad:
+        v = jnp.pad(v, (0, pad))
+    return v.reshape(rows, lane)
+
+
+def _best_block(rows, want):
+    b = max(1, min(want, rows))
+    while rows % b:
+        b -= 1
+    return b
+
+
+def _expand(per_tensor, sizes, total):
+    """Expand a (P,) per-tensor vector onto the flat (S,) buffer.
+    ``jnp.repeat`` with a static ``total_repeat_length`` computes the
+    gather plan on device from the (P,) sizes -- no S-sized host
+    constant baked into the program (ResNet-50's S is ~25M)."""
+    return jnp.repeat(per_tensor, jnp.asarray(sizes),
+                      total_repeat_length=total)
+
+
+# ----------------------------------------------------------------------
+# flat LARS / momentum update
+# ----------------------------------------------------------------------
+
+def _lars_math(w, g, m, lr, wd, sign, rescale, momentum, clip):
+    """One fused elementwise pass over the flat buffer: per-element
+    ``lr`` already carries the per-tensor trust ratio; ``sign`` +1 for
+    LARS-convention momentum, -1 for the skip-list's sgd-convention
+    momentum (identical trajectories, sign-compatible stored state)."""
+    wf = w.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    mf = m.astype(jnp.float32)
+    gr = gf * rescale
+    if clip is not None and clip > 0:
+        gr = jnp.clip(gr, -clip, clip)
+    step = lr * (gr + wd * wf)
+    nm = momentum * mf + sign * step
+    nw = wf - sign * nm
+    return nw.astype(w.dtype), nm.astype(m.dtype)
+
+
+def _lars_flat_kernel(w_ref, g_ref, m_ref, lr_ref, wd_ref, sg_ref,
+                      rs_ref, w_out, m_out, *, momentum, clip):
+    rescale = rs_ref[0, 0]
+    w = w_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    gr = g * rescale
+    if clip is not None and clip > 0:
+        gr = jnp.clip(gr, -clip, clip)
+    step = lr_ref[...] * (gr + wd_ref[...] * w)
+    nm = momentum * m + sg_ref[...] * step
+    nw = w - sg_ref[...] * nm
+    w_out[...] = nw.astype(w_out.dtype)
+    m_out[...] = nm.astype(m_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("momentum", "clip",
+                                             "block_rows", "interpret"))
+def lars_flat_pallas(w, g, m, lr, wd, sign, rescale, momentum=0.9,
+                     clip=0.0, block_rows=64, interpret=False):
+    """The flat momentum update as ONE Pallas kernel over the padded
+    (rows, 128) view of the concatenated buffer."""
+    n = w.shape[0]
+    ops2d = [_pad2d(v) for v in (w, g, m, lr, wd, sign)]
+    rows, lane = ops2d[0].shape
+    block_rows = _best_block(rows, block_rows)
+    row = pl.BlockSpec((block_rows, lane), lambda i: (i, 0))
+    scalar = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    rs = jnp.asarray(rescale, jnp.float32).reshape(1, 1)
+    nw, nm = pl.pallas_call(
+        functools.partial(_lars_flat_kernel, momentum=momentum,
+                          clip=clip),
+        out_shape=[jax.ShapeDtypeStruct(ops2d[0].shape, w.dtype),
+                   jax.ShapeDtypeStruct(ops2d[2].shape, m.dtype)],
+        grid=(rows // block_rows,),
+        in_specs=[row] * 6 + [scalar],
+        out_specs=[row, row],
+        interpret=interpret,
+    )(*ops2d, rs)
+    return nw.reshape(-1)[:n], nm.reshape(-1)[:n]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flat_lars(w, g, m, lr, wd, sign, rescale, momentum, clip,
+               use_pallas, interpret):
+    if use_pallas:
+        return lars_flat_pallas(w, g, m, lr, wd, sign, rescale,
+                                momentum=momentum, clip=clip,
+                                interpret=interpret)
+    return _lars_math(w, g, m, lr, wd, sign, rescale, momentum, clip)
+
+
+def _flat_lars_fwd(w, g, m, lr, wd, sign, rescale, momentum, clip,
+                   use_pallas, interpret):
+    out = _flat_lars(w, g, m, lr, wd, sign, rescale, momentum, clip,
+                     use_pallas, interpret)
+    return out, (w, g, m, lr, wd, sign, rescale)
+
+
+def _flat_lars_bwd(momentum, clip, use_pallas, interpret, res, cts):
+    # backward = XLA math replay (the layernorm-kernel pattern): exact
+    # autodiff of the update formula, trust ratio riding the lr input
+    w, g, m, lr, wd, sign, rescale = res
+    _, vjp = jax.vjp(
+        lambda *ins: _lars_math(*ins, momentum, clip),
+        w, g, m, lr, wd, sign, rescale)
+    return vjp(cts)
+
+
+_flat_lars.defvjp(_flat_lars_fwd, _flat_lars_bwd)
+
+
+def lars_bucket_update(ws, gs, ms, lrs, wds, skips, momentum=0.9,
+                       eta=0.001, epsilon=1e-9, rescale=1.0, clip=None,
+                       choice=None):
+    """Bucket-flattened LARS over parameter lists.
+
+    ``ws``/``gs``/``ms``: weights, gradients, momenta (raw arrays, same
+    order); ``lrs``/``wds``: per-tensor scalars (python or traced);
+    ``skips``: static per-tensor bools selecting the plain-momentum
+    path (bias/gamma/beta, the reference's skip list).  Returns
+    ``(new_ws, new_ms)`` in input order."""
+    ch = choice if choice is not None else choose("bucket_optimizer")
+    clipv = float(clip) if clip is not None and clip > 0 else 0.0
+    rs = jnp.asarray(rescale, jnp.float32)
+    new_ws = [None] * len(ws)
+    new_ms = [None] * len(ws)
+    for _dtype, idxs in dtype_groups(ws):
+        lr_t, wd_t = [], []
+        for i in idxs:
+            gf = gs[i].astype(jnp.float32) * rs
+            if clipv > 0:
+                gf = jnp.clip(gf, -clipv, clipv)
+            if skips[i]:
+                trust = jnp.float32(1.0)
+            else:
+                wn = jnp.sqrt(jnp.sum(
+                    jnp.square(ws[i].astype(jnp.float32))))
+                gn = jnp.sqrt(jnp.sum(jnp.square(gf)))
+                trust = jnp.where(
+                    jnp.logical_and(wn > 0, gn > 0),
+                    eta * wn / (gn + wds[i] * wn + epsilon), 1.0)
+            lr_t.append(jnp.asarray(lrs[i], jnp.float32) * trust)
+            wd_t.append(jnp.asarray(wds[i], jnp.float32))
+        sizes = [int(ws[i].size) for i in idxs]
+        total = sum(sizes)
+        lr_vec = _expand(jnp.stack(lr_t), sizes, total)
+        wd_vec = _expand(jnp.stack(wd_t), sizes, total)
+        sign_vec = _expand(
+            jnp.asarray(np.where([skips[i] for i in idxs], -1.0, 1.0)
+                        .astype(np.float32)), sizes, total)
+        W = flatten_group(ws, idxs, jnp)
+        G = flatten_group(gs, idxs, jnp)
+        M = flatten_group(ms, idxs, jnp)
+        nW, nM = _flat_lars(W, G, M, lr_vec, wd_vec, sign_vec, rs,
+                            float(momentum), clipv, ch.use_pallas,
+                            ch.interpret)
+        shapes = [ws[i].shape for i in idxs]
+        for i, pw, pm in zip(idxs, split_group(nW, shapes),
+                             split_group(nM, shapes)):
+            new_ws[i] = pw
+            new_ms[i] = pm
+    return new_ws, new_ms
+
+
+# ----------------------------------------------------------------------
+# flat LAMB: phase 1 elementwise over the flat buffer, per-tensor
+# trust via segment reductions, phase 2 elementwise
+# ----------------------------------------------------------------------
+
+def _lamb1_math(w, g, m, v, wd, scalars, beta1, beta2, eps, clip):
+    rescale, bc1, bc2 = scalars[0], scalars[1], scalars[2]
+    wf = w.astype(jnp.float32)
+    gr = g.astype(jnp.float32) * rescale
+    if clip is not None and clip > 0:
+        gr = jnp.clip(gr, -clip, clip)
+    nm = beta1 * m.astype(jnp.float32) + (1 - beta1) * gr
+    nv = beta2 * v.astype(jnp.float32) + (1 - beta2) * gr * gr
+    gw = (nm * bc1) / (jnp.sqrt(nv * bc2) + eps) + wd * wf
+    return gw, nm.astype(m.dtype), nv.astype(v.dtype)
+
+
+def _lamb1_kernel(w_ref, g_ref, m_ref, v_ref, wd_ref, sc_ref,
+                  gw_ref, nm_ref, nv_ref, *, beta1, beta2, eps, clip):
+    rescale = sc_ref[0, 0]
+    bc1 = sc_ref[0, 1]
+    bc2 = sc_ref[0, 2]
+    w = w_ref[...].astype(jnp.float32)
+    gr = g_ref[...].astype(jnp.float32) * rescale
+    if clip is not None and clip > 0:
+        gr = jnp.clip(gr, -clip, clip)
+    nm = beta1 * m_ref[...].astype(jnp.float32) + (1 - beta1) * gr
+    nv = beta2 * v_ref[...].astype(jnp.float32) + (1 - beta2) * gr * gr
+    gw = (nm * bc1) / (jnp.sqrt(nv * bc2) + eps) + wd_ref[...] * w
+    gw_ref[...] = gw
+    nm_ref[...] = nm.astype(nm_ref.dtype)
+    nv_ref[...] = nv.astype(nv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("beta1", "beta2", "eps",
+                                             "clip", "block_rows",
+                                             "interpret"))
+def lamb_phase1_pallas(w, g, m, v, wd, scalars, beta1=0.9, beta2=0.999,
+                       eps=1e-6, clip=0.0, block_rows=64,
+                       interpret=False):
+    n = w.shape[0]
+    ops2d = [_pad2d(x) for x in (w, g, m, v, wd)]
+    rows, lane = ops2d[0].shape
+    block_rows = _best_block(rows, block_rows)
+    row = pl.BlockSpec((block_rows, lane), lambda i: (i, 0))
+    sc = pl.BlockSpec((1, 3), lambda i: (0, 0))
+    gw, nm, nv = pl.pallas_call(
+        functools.partial(_lamb1_kernel, beta1=beta1, beta2=beta2,
+                          eps=eps, clip=clip),
+        out_shape=[jax.ShapeDtypeStruct(ops2d[0].shape, jnp.float32),
+                   jax.ShapeDtypeStruct(ops2d[2].shape, m.dtype),
+                   jax.ShapeDtypeStruct(ops2d[3].shape, v.dtype)],
+        grid=(rows // block_rows,),
+        in_specs=[row] * 5 + [sc],
+        out_specs=[row, row, row],
+        interpret=interpret,
+    )(*ops2d, scalars.reshape(1, 3))
+    return (gw.reshape(-1)[:n], nm.reshape(-1)[:n], nv.reshape(-1)[:n])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flat_lamb1(w, g, m, v, wd, scalars, beta1, beta2, eps, clip,
+                use_pallas, interpret):
+    if use_pallas:
+        return lamb_phase1_pallas(w, g, m, v, wd, scalars, beta1=beta1,
+                                  beta2=beta2, eps=eps, clip=clip,
+                                  interpret=interpret)
+    return _lamb1_math(w, g, m, v, wd, scalars, beta1, beta2, eps, clip)
+
+
+def _flat_lamb1_fwd(w, g, m, v, wd, scalars, beta1, beta2, eps, clip,
+                    use_pallas, interpret):
+    out = _flat_lamb1(w, g, m, v, wd, scalars, beta1, beta2, eps, clip,
+                      use_pallas, interpret)
+    return out, (w, g, m, v, wd, scalars)
+
+
+def _flat_lamb1_bwd(beta1, beta2, eps, clip, use_pallas, interpret,
+                    res, cts):
+    w, g, m, v, wd, scalars = res
+    _, vjp = jax.vjp(
+        lambda *ins: _lamb1_math(*ins, beta1, beta2, eps, clip),
+        w, g, m, v, wd, scalars)
+    return vjp(cts)
+
+
+_flat_lamb1.defvjp(_flat_lamb1_fwd, _flat_lamb1_bwd)
+
+
+def lamb_bucket_update(ws, gs, means, variances, lrs, wds, t, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, bias_correction=True,
+                       lower_bound=None, upper_bound=None, rescale=1.0,
+                       clip=None, choice=None):
+    """Bucket-flattened LAMB: phase-1 update direction over the flat
+    buffer (Pallas when selected), per-tensor ``r1``/``r2`` trust norms
+    via segment reductions, phase-2 trust-scaled step over the flat
+    buffer.  ``t`` is the (traced) step count for bias correction.
+    Returns ``(new_ws, new_means, new_vars)`` in input order."""
+    ch = choice if choice is not None else choose("bucket_optimizer")
+    clipv = float(clip) if clip is not None and clip > 0 else 0.0
+    bc1 = 1.0 / (1.0 - beta1 ** t) if bias_correction else 1.0
+    bc2 = 1.0 / (1.0 - beta2 ** t) if bias_correction else 1.0
+    scalars = jnp.stack([jnp.asarray(rescale, jnp.float32),
+                         jnp.asarray(bc1, jnp.float32),
+                         jnp.asarray(bc2, jnp.float32)])
+    new_ws = [None] * len(ws)
+    new_means = [None] * len(ws)
+    new_vars = [None] * len(ws)
+    for _dtype, idxs in dtype_groups(ws):
+        sizes = [int(ws[i].size) for i in idxs]
+        nseg = len(idxs)
+        total = sum(sizes)
+        seg = _expand(jnp.arange(nseg), sizes, total)
+        lr_vec = _expand(jnp.stack([jnp.asarray(lrs[i], jnp.float32)
+                                    for i in idxs]), sizes, total)
+        wd_vec = _expand(jnp.stack([jnp.asarray(wds[i], jnp.float32)
+                                    for i in idxs]), sizes, total)
+        W = flatten_group(ws, idxs, jnp)
+        G = flatten_group(gs, idxs, jnp)
+        Mn = flatten_group(means, idxs, jnp)
+        V = flatten_group(variances, idxs, jnp)
+        gw, nm, nv = _flat_lamb1(W, G, Mn, V, wd_vec, scalars,
+                                 float(beta1), float(beta2),
+                                 float(epsilon), clipv, ch.use_pallas,
+                                 ch.interpret)
+        # per-tensor trust ratio (lamb_update_phase2 semantics)
+        wf = W.astype(jnp.float32)
+        r1 = jnp.sqrt(jax.ops.segment_sum(wf * wf, seg,
+                                          num_segments=nseg,
+                                          indices_are_sorted=True))
+        r2 = jnp.sqrt(jax.ops.segment_sum(gw * gw, seg,
+                                          num_segments=nseg,
+                                          indices_are_sorted=True))
+        if lower_bound is not None and lower_bound > 0:
+            r1 = jnp.maximum(r1, lower_bound)
+        if upper_bound is not None and upper_bound > 0:
+            r1 = jnp.minimum(r1, upper_bound)
+        ratio = jnp.where(jnp.logical_or(r1 == 0, r2 == 0), 1.0, r1 / r2)
+        nW = (wf - lr_vec * jnp.take(ratio, seg) * gw).astype(W.dtype)
+        shapes = [ws[i].shape for i in idxs]
+        for i, pw, pm, pv in zip(idxs, split_group(nW, shapes),
+                                 split_group(nm, shapes),
+                                 split_group(nv, shapes)):
+            new_ws[i] = pw
+            new_means[i] = pm
+            new_vars[i] = pv
+    return new_ws, new_means, new_vars
+
+
+# ----------------------------------------------------------------------
+# TrainStep integration (called inside the traced step under
+# parallel.data_parallel._scalar_feed)
+# ----------------------------------------------------------------------
+
+def bucket_supported(opt) -> bool:
+    """Whether the optimizer has a bucket-flattened update."""
+    from ..optimizer import LAMB, LARS
+    return type(opt) in (LARS, LAMB) and not opt.multi_precision
+
+
+def bucket_active(opt) -> bool:
+    """The compiled-train-step gate: the bucketed update replaces the
+    per-parameter loop only under MXNET_TPU_KERNELS=1 (the XLA-vs-
+    Pallas choice for the flat pass is the registry's, inside)."""
+    return mode() == "on" and bucket_supported(opt)
+
+
+def bucket_update(opt, items):
+    """Fused update for the compiled train step: ``items`` is
+    ``[(index, weight_val, grad_val, state_val)]`` with raw (traced)
+    arrays; must run under ``_scalar_feed`` so ``opt._get_lr`` /
+    ``_get_wd`` / ``_index_update_count`` yield the traced per-step
+    feeds.  Returns ``({index: new_weight}, {index: new_state})`` with
+    states in the optimizer's own structure."""
+    from ..optimizer import LARS
+    idxs = [i for i, _w, _g, _s in items]
+    ws = [w for _i, w, _g, _s in items]
+    gs = [g for _i, _w, g, _s in items]
+    lrs = [opt._get_lr(i) for i in idxs]
+    wds = [opt._get_wd(i) for i in idxs]
+    rescale = opt.rescale_grad
+    clip = opt.clip_gradient
+    ch = choose("bucket_optimizer")
+    if type(opt) is LARS:
+        ms = [s for _i, _w, _g, s in items]
+        skips = [bool(opt._skip_lars(i)) for i in idxs]
+        nws, nms = lars_bucket_update(
+            ws, gs, ms, lrs, wds, skips, momentum=opt.momentum,
+            eta=opt.eta, epsilon=opt.epsilon, rescale=rescale,
+            clip=clip, choice=ch)
+        return ({i: w for i, w in zip(idxs, nws)},
+                {i: m for i, m in zip(idxs, nms)})
+    means = [s[0] for _i, _w, _g, s in items]
+    variances = [s[1] for _i, _w, _g, s in items]
+    t = opt._index_update_count[idxs[0]]
+    nws, nmeans, nvars = lamb_bucket_update(
+        ws, gs, means, variances, lrs, wds, t, beta1=opt.beta1,
+        beta2=opt.beta2, epsilon=opt.epsilon,
+        bias_correction=opt.bias_correction,
+        lower_bound=opt.lower_bound, upper_bound=opt.upper_bound,
+        rescale=rescale, clip=clip, choice=ch)
+    return ({i: w for i, w in zip(idxs, nws)},
+            {i: (m, v) for i, m, v in zip(idxs, nmeans, nvars)})
+
+
+register_kernel(KernelSpec(
+    name="bucket_optimizer",
+    doc="LARS/LAMB trust-ratio + momentum update over one concatenated "
+        "per-dtype buffer (shared mxnet_tpu.bucketing grouping): the "
+        "per-parameter elementwise-kernel swarm in the compiled train "
+        "step becomes one flat pass (Pallas VMEM kernel when selected) "
+        "plus small fused trust-norm reductions.  Opt-in via "
+        "MXNET_TPU_KERNELS=1.",
+    categories=("elementwise_fusion",),
+    remedies=(),
+    supports=None,
+    auto_predicate=lambda **_kw: False,
+))

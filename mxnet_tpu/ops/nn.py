@@ -304,6 +304,29 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
             lax.stop_gradient(new_var))
 
 
+@register("fused_batch_norm_relu",
+          args=("data", "gamma", "beta", "moving_mean", "moving_var"),
+          num_diff_outputs=1)
+def _fused_batch_norm_relu(data, gamma, beta, moving_mean, moving_var,
+                           eps=1e-5, momentum=0.9, fix_gamma=True,
+                           use_global_stats=False, axis=1,
+                           training=False):
+    """Fused BatchNorm+ReLU (kernel tier, docs/kernels.md): same
+    functional contract as ``BatchNorm`` -- returns ``(out,
+    new_moving_mean, new_moving_var)`` -- with the relu epilogue fused
+    into the normalize pass.  Kernel-vs-XLA selection happens ONCE in
+    the registry (``kernels.choose('fused_bn_relu')``): the Pallas VMEM
+    kernel on TPU (channels-last inputs; interpret mode on CPU under
+    MXNET_TPU_KERNELS=1), ``relu(BatchNorm(...))`` otherwise.  The
+    gluon ``HybridSequential`` BatchNorm+Activation fusion sites
+    dispatch here when the tier is armed."""
+    from ..kernels.fused_bn_relu import fused_bn_relu as _fused
+    return _fused(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                  momentum=momentum, fix_gamma=fix_gamma,
+                  use_global_stats=use_global_stats, axis=axis,
+                  training=training)
+
+
 def _ln_xla_lastaxis(data, gamma, beta, eps):
     xf = data.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)

@@ -1,4 +1,9 @@
-"""Hand-written Pallas TPU kernels for hot ops (flash attention)."""
-from .flash_attention import flash_attention_fwd_pallas
+"""Hand-written Pallas TPU kernel bodies (flash attention, layernorm).
 
-__all__ = ["flash_attention_fwd_pallas"]
+Selection/fallback policy lives in ``mxnet_tpu.kernels`` (the kernel
+registry, docs/kernels.md); these modules hold only the kernels.
+"""
+from .flash_attention import (flash_attention_bwd_pallas,
+                              flash_attention_fwd_pallas)
+
+__all__ = ["flash_attention_fwd_pallas", "flash_attention_bwd_pallas"]

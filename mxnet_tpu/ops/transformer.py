@@ -148,29 +148,32 @@ def _xla_attention_bwd(q, k, v, dout, causal, scale, mask=None):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, use_pallas):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, use_pallas,
+           interpret):
     if use_pallas:
         from .pallas.flash_attention import flash_attention_fwd_pallas
         out, _lse = flash_attention_fwd_pallas(
             q, k, v, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k)
+            block_k=block_k, interpret=interpret)
         return out
     return _attention_reference(q, k, v, causal, scale)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, use_pallas):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, use_pallas,
+               interpret):
     if use_pallas:
         from .pallas.flash_attention import flash_attention_fwd_pallas
         out, lse = flash_attention_fwd_pallas(
             q, k, v, causal=causal, scale=scale, block_q=block_q,
-            block_k=block_k)
+            block_k=block_k, interpret=interpret)
         return out, (q, k, v, out, lse)
-    return _flash(q, k, v, causal, scale, block_q, block_k, use_pallas), \
-        (q, k, v, None, None)
+    return _flash(q, k, v, causal, scale, block_q, block_k, use_pallas,
+                  interpret), (q, k, v, None, None)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, use_pallas, res, dout):
+def _flash_bwd(causal, scale, block_q, block_k, use_pallas, interpret,
+               res, dout):
     q, k, v, out, lse = res
     if use_pallas and lse is not None:
         # blockwise Pallas backward: O(seq*d) memory, replays score
@@ -180,7 +183,7 @@ def _flash_bwd(causal, scale, block_q, block_k, use_pallas, res, dout):
                         * out.astype(jnp.float32), axis=-1)
         return flash_attention_bwd_pallas(
             q, k, v, lse, dout, delta, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k)
+            block_q=block_q, block_k=block_k, interpret=interpret)
     return _xla_attention_bwd(q, k, v, dout, causal, scale)
 
 
@@ -189,14 +192,14 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 # masked variant: the padding mask (batch, seq_q, seq_k) rides into the
 # kernels; heads is static so programs can map bh -> batch
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash_masked(q, k, v, maskf, scale, block_q, block_k, use_pallas,
-                  heads):
+                  heads, interpret):
     if use_pallas:
         from .pallas.flash_attention import flash_attention_fwd_pallas
         out, _lse = flash_attention_fwd_pallas(
             q, k, v, maskf, causal=False, scale=scale, block_q=block_q,
-            block_k=block_k, heads=heads)
+            block_k=block_k, heads=heads, interpret=interpret)
         return out
     m = jnp.repeat(maskf, heads, axis=0)
     return _attention_reference_masked(q, k, v, m, scale)
@@ -215,20 +218,20 @@ def _attention_reference_masked(q, k, v, mask_bh, scale):
 
 
 def _flash_masked_fwd(q, k, v, maskf, scale, block_q, block_k, use_pallas,
-                      heads):
+                      heads, interpret):
     if use_pallas:
         from .pallas.flash_attention import flash_attention_fwd_pallas
         out, lse = flash_attention_fwd_pallas(
             q, k, v, maskf, causal=False, scale=scale, block_q=block_q,
-            block_k=block_k, heads=heads)
+            block_k=block_k, heads=heads, interpret=interpret)
         return out, (q, k, v, maskf, out, lse)
     out = _flash_masked(q, k, v, maskf, scale, block_q, block_k,
-                        use_pallas, heads)
+                        use_pallas, heads, interpret)
     return out, (q, k, v, maskf, None, None)
 
 
-def _flash_masked_bwd(scale, block_q, block_k, use_pallas, heads, res,
-                      dout):
+def _flash_masked_bwd(scale, block_q, block_k, use_pallas, heads,
+                      interpret, res, dout):
     q, k, v, maskf, out, lse = res
     if use_pallas and lse is not None:
         from .pallas.flash_attention import flash_attention_bwd_pallas
@@ -236,7 +239,8 @@ def _flash_masked_bwd(scale, block_q, block_k, use_pallas, heads, res,
                         * out.astype(jnp.float32), axis=-1)
         dq, dk, dv = flash_attention_bwd_pallas(
             q, k, v, lse, dout, delta, maskf, causal=False, scale=scale,
-            block_q=block_q, block_k=block_k, heads=heads)
+            block_q=block_q, block_k=block_k, heads=heads,
+            interpret=interpret)
     else:
         m = jnp.repeat(maskf, heads, axis=0)
         dq, dk, dv = _xla_attention_bwd(q, k, v, dout, False, scale,
@@ -247,16 +251,17 @@ def _flash_masked_bwd(scale, block_q, block_k, use_pallas, heads, res,
 _flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
 
 
-def _auto_tileable(seq, block_q, block_k):
-    """auto kernel choice: Pallas only where it wins.  Measured on v5e
-    (BERT-base bf16 train, r3): seq 128 pallas 93k vs xla 117k tok/s;
-    seq 256 111k vs 107k; seq 512 93k vs 85k; seq 1024 81k vs 60k --
-    the crossover is ~256, below which XLA's fused materialized-scores
-    path is faster and above which the O(seq^2) HBM traffic dominates."""
-    from .pallas.flash_attention import _HAS_PALLAS
-    bq, bk = min(block_q, seq), min(block_k, seq)
-    return (_HAS_PALLAS and seq >= 256
-            and seq % bq == 0 and seq % bk == 0)
+def _kernel_choice(seq, block_q, block_k, use_pallas):
+    """THE selection point (docs/kernels.md): one registry consult
+    replaces the five ``use_pallas`` branches that used to be scattered
+    through this file.  Auto mode carries the measured v5e crossover
+    (seq >= 256 -- see ``kernels/flash_attention.py`` for the per-seq
+    numbers) and picks the Pallas kernels on TPU only; forced mode runs
+    them in interpret mode on CPU so tests exercise the kernel bodies;
+    availability and seq/block divisibility are checked once here."""
+    from ..kernels import choose
+    return choose("flash_attention", force=use_pallas, seq=seq,
+                  block_q=block_q, block_k=block_k)
 
 
 @register("flash_attention", args=("q", "k", "v"))
@@ -266,29 +271,20 @@ def _flash_attention_op(q, k, v, causal=False, scale=-1.0, use_pallas=None,
     head_dim) tensors.  ``use_pallas``: True = Pallas kernels (forward
     AND blockwise backward, O(seq*d) memory), False = XLA reference
     path (plain softmax attention, autodiffed by XLA -- the fastest
-    short-sequence path), None (default) = auto: above the measured
-    Pallas crossover (seq >= 256), ``lax.platform_dependent`` selects
-    the Pallas kernels when lowering for *tpu* and the portable XLA
-    path for every other platform; below it, the plain XLA path is
-    returned directly with no custom_vjp wrapper, so XLA saves the
-    softmax from the forward instead of recomputing it in the backward.
+    short-sequence path), None (default) = the kernel registry's
+    policy (``kernels.choose('flash_attention')``): Pallas above the
+    measured crossover on TPU, the plain XLA path otherwise -- with no
+    custom_vjp wrapper on the fallback, so XLA saves the softmax from
+    the forward instead of recomputing it in the backward.
     ``scale < 0`` means 1/sqrt(head_dim)."""
     if scale is None or scale < 0:
         scale = 1.0 / math.sqrt(q.shape[-1])
     causal, scale = bool(causal), float(scale)
     block_q, block_k = int(block_q), int(block_k)
-    if use_pallas is None:
-        if _auto_tileable(q.shape[1], block_q, block_k):
-            # custom_vjp functions take positional args only
-            return jax.lax.platform_dependent(
-                q, k, v,
-                tpu=lambda a, b, c: _flash(a, b, c, causal, scale,
-                                           block_q, block_k, True),
-                default=lambda a, b, c: _attention_reference(
-                    a, b, c, causal, scale))
-        return _attention_reference(q, k, v, causal, scale)
-    if use_pallas:
-        return _flash(q, k, v, causal, scale, block_q, block_k, True)
+    ch = _kernel_choice(q.shape[1], block_q, block_k, use_pallas)
+    if ch.use_pallas:
+        return _flash(q, k, v, causal, scale, block_q, block_k, True,
+                      ch.interpret)
     return _attention_reference(q, k, v, causal, scale)
 
 
@@ -298,27 +294,16 @@ def _flash_attention_masked_op(q, k, v, mask, scale=-1.0, use_pallas=None,
     """Masked flash attention: ``mask`` is (batch, seq_q, seq_k) with
     nonzero = attend, shared across the ``heads`` heads folded into
     q/k/v's leading dim.  Same kernel selection rules as
-    ``flash_attention``."""
+    ``flash_attention`` (one registry consult)."""
     if scale is None or scale < 0:
         scale = 1.0 / math.sqrt(q.shape[-1])
     scale = float(scale)
     block_q, block_k = int(block_q), int(block_k)
     heads = int(heads)
     maskf = mask.astype(jnp.float32)
-
-    def _xla_plain(a, b, c, m):
-        return _attention_reference_masked(
-            a, b, c, jnp.repeat(m, heads, axis=0), scale)
-
-    if use_pallas is None:
-        if _auto_tileable(q.shape[1], block_q, block_k):
-            return jax.lax.platform_dependent(
-                q, k, v, maskf,
-                tpu=lambda a, b, c, m: _flash_masked(
-                    a, b, c, m, scale, block_q, block_k, True, heads),
-                default=_xla_plain)
-        return _xla_plain(q, k, v, maskf)
-    if use_pallas:
+    ch = _kernel_choice(q.shape[1], block_q, block_k, use_pallas)
+    if ch.use_pallas:
         return _flash_masked(q, k, v, maskf, scale, block_q, block_k,
-                             True, heads)
-    return _xla_plain(q, k, v, maskf)
+                             True, heads, ch.interpret)
+    return _attention_reference_masked(
+        q, k, v, jnp.repeat(maskf, heads, axis=0), scale)

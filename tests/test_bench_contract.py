@@ -53,6 +53,18 @@ def bench_mod(monkeypatch):
                              "collective_bytes": 67884}])
     monkeypatch.setattr(bench, "_subprocess_pair",
                         lambda *a, **k: (2000.0, 0.8))
+    # the kernel-tier HLO diff compiles two probe models; stub it with
+    # the contract shape (the REAL probe is covered by test_kernels.py)
+    monkeypatch.setattr(
+        bench, "_kernels_diff",
+        lambda model: {
+            "probe": model, "after_interpret": False,
+            "before": {"transpose_layout": 1000,
+                       "unfused_elementwise": 500, "bytes_total": 4000},
+            "after": {"transpose_layout": 400,
+                      "unfused_elementwise": 100, "bytes_total": 3000},
+            "delta": {"transpose_layout": -600,
+                      "unfused_elementwise": -400, "bytes_total": -1000}})
     # _emit_with_retry sleeps between real retries; stubs don't need it
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     import mxnet_tpu as mx
@@ -83,6 +95,53 @@ def test_headline_lines_emit_first(bench_mod, capsys):
     head = by["resnet50_imagenet_train"]
     assert head["vs_baseline"] == round(2600.0 / 3000.0, 4)
     assert metrics[-1] == "bench_complete"
+
+
+def test_every_emitted_line_carries_degraded_env(bench_mod, capsys):
+    """ISSUE 11 satellite (bench hygiene): every emitted JSONL line
+    carries a `degraded_env` boolean derived from the env_health
+    probe's dispatch_roundtrip threshold, so an r05-style tunnel
+    collapse can never again be read as a perf regression."""
+    bench_mod.main()
+    _names, lines = _metrics(capsys)
+    for ln in lines:
+        if ln["metric"] == "bench_complete" or ln.get("skipped"):
+            continue
+        assert "degraded_env" in ln, ln["metric"]
+    by = {ln["metric"]: ln for ln in lines}
+    # the stub probe reports a 2us dispatch RTT: healthy
+    assert by["env_health"]["degraded_env"] is False
+    assert by["resnet50_imagenet_train_bf16_scan"]["degraded_env"] is False
+    assert by["resnet50_imagenet_train"]["degraded_env"] is False
+
+
+def test_degraded_env_flips_on_slow_dispatch(bench_mod, capsys,
+                                             monkeypatch):
+    """A collapsed-tunnel dispatch RTT (r05: ~90ms) marks EVERY line
+    degraded, headline included."""
+    monkeypatch.setattr(bench_mod, "bench_env_health",
+                        lambda **k: {"h2d_mb_per_s": 1.0,
+                                     "dispatch_roundtrip_us": 90000.0})
+    bench_mod.main()
+    _names, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    assert by["env_health"]["degraded_env"] is True
+    assert by["resnet50_imagenet_train_bf16_scan"]["degraded_env"] is True
+    assert by["resnet50_imagenet_train"]["degraded_env"] is True
+
+
+def test_scan_line_carries_kernels_diff(bench_mod, capsys):
+    """ISSUE 11 acceptance: the resnet50-scan line carries the kernel
+    tier's before/after mxprof category deltas (transpose_layout /
+    unfused-elementwise bytes)."""
+    bench_mod.main()
+    _names, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    kd = by["resnet50_imagenet_train_bf16_scan"]["kernels_diff"]
+    for key in ("probe", "after_interpret", "before", "after", "delta"):
+        assert key in kd, key
+    assert kd["delta"]["transpose_layout"] < 0
+    assert kd["delta"]["unfused_elementwise"] < 0
 
 
 def test_budget_exhaustion_skips_garnish_only(bench_mod, capsys,
