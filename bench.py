@@ -795,6 +795,104 @@ def bench_serving(offered_qps=(100, 400, 1600), duration_s=2.0,
     return curve
 
 
+def bench_serving_hotswap(duration_s=2.0, clients=4, buckets=(1, 2, 4, 8),
+                          max_wait_ms=3.0, publish_every=2):
+    """Hot-swap cost under live traffic (ISSUE 12 bench contract).
+
+    A servable behind the PRODUCT always-on loop
+    (``serving.ContinuousTrainer`` publishing atomic checkpoints +
+    ``serving.RegistryWatcher`` re-registering the servable) takes
+    open-loop traffic from ``clients`` threads; mid-run the trainer
+    publishes a newer step and the watcher hot-swaps it in
+    (warm-compile the replacement while the old one serves, install,
+    drain).  Recorded: the swap wall (checkpoint-visible -> new step
+    serving), p50/p99 split into during-swap vs steady windows (a
+    request is "during" when its lifetime overlaps the swap), and the
+    zero-dropped contract (``dropped`` must be 0 -- registry-path
+    clients never see the swap).  Runs on CPU.
+    """
+    import shutil
+    import tempfile
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu.chaos import scenarios as _scen
+    from mxnet_tpu.serving.loop import ContinuousTrainer, RegistryWatcher
+
+    root = tempfile.mkdtemp(prefix="mxtpu_hotswap_bench_")
+    reg = None
+    try:
+        net, trainer, loss_fn, data = _scen.train_fixtures(seed=0)
+        ct = ContinuousTrainer(net, trainer, loss_fn, data, root,
+                               publish_every=publish_every)
+        reg = mx.serving.ModelRegistry(compile_cache=False)
+        watcher = RegistryWatcher(reg, "model", ct.manager,
+                                  _scen.make_mlp(), input_shape=(8,),
+                                  buckets=buckets, swap_retries=0,
+                                  max_wait_ms=max_wait_ms,
+                                  max_queue=1024)
+        ct.run_steps(publish_every)
+        watcher.poll_once()                  # initial servable
+        records = []          # (t_submit, latency); append is GIL-atomic
+        dropped = [0]
+        stop = threading.Event()
+        sample = np.random.RandomState(0).rand(8).astype(np.float32)
+
+        def client():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    reg.infer("model", sample, timeout=10)
+                    records.append((t0, time.perf_counter() - t0))
+                except Exception:
+                    dropped[0] += 1
+                # open-loop pacing, not state polling
+                time.sleep(0.001)  # mxlint: disable=sleep-poll
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        time.sleep(duration_s * 0.4)         # steady window (old model)
+        ct.run_steps(publish_every)          # publish the newer step
+        t_swap0 = time.perf_counter()
+        swapped = watcher.poll_once()        # restore+warm+install+drain
+        t_swap1 = time.perf_counter()
+        time.sleep(duration_s * 0.4)         # steady window (new model)
+        stop.set()
+        for t in threads:
+            t.join()
+        ct.close()
+        watcher.close()
+        reg.shutdown(drain=True)
+        reg = None
+        during = [lat for (t0, lat) in records
+                  if t0 <= t_swap1 and t0 + lat >= t_swap0]
+        steady = [lat for (t0, lat) in records
+                  if not (t0 <= t_swap1 and t0 + lat >= t_swap0)]
+
+        def pct(lats, q):
+            lats = sorted(lats)
+            return round(1e3 * lats[min(len(lats) - 1,
+                                        int(q * len(lats)))], 3) \
+                if lats else None
+
+        return {
+            "swap_step": swapped,
+            "swap_latency_ms": round(1e3 * (t_swap1 - t_swap0), 3),
+            "p50_steady_ms": pct(steady, 0.50),
+            "p99_steady_ms": pct(steady, 0.99),
+            "p50_during_swap_ms": pct(during, 0.50),
+            "p99_during_swap_ms": pct(during, 0.99),
+            "requests": len(records) + dropped[0],
+            "requests_during_swap": len(during),
+            "dropped": dropped[0],
+        }
+    finally:
+        if reg is not None:
+            reg.shutdown(drain=True)
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
                     dtype="float32", use_flash=None, iters=20,
                     windows=1):
@@ -1222,6 +1320,18 @@ def main():
                          "vs_baseline": None})
         except Exception as e:
             _print_line({"metric": "serving_latency_qps",
+                         "error": str(e)[:200]})
+
+    # always-on loop: hot-swap cost under live traffic (ISSUE 12 bench
+    # contract: swap latency + p99-during-swap, zero dropped)
+    if _budget_ok("serving_hotswap", 90):
+        try:
+            rec = bench_serving_hotswap(
+                duration_s=3.0 if on_tpu else 2.0)
+            _print_line({"metric": "serving_hotswap", "unit": "ms",
+                         "vs_baseline": None, **rec})
+        except Exception as e:
+            _print_line({"metric": "serving_hotswap",
                          "error": str(e)[:200]})
 
     if _budget_ok("lenet_mnist_train", 120):

@@ -24,6 +24,16 @@
 #              graceful drain, per-request numerics vs the direct
 #              forward, and a non-empty `serving` section (ordered
 #              p50<=p99 percentiles) from the summarize CLI
+#   chaos -> the always-on loop under injected faults (docs/chaos.md,
+#            fixed seed): the chaos test file, then a REAL
+#            kill-mid-commit (subprocess dies with os._exit between the
+#            staged data files and the manifest commit -> discovery
+#            must cost one step, never the job, and the next manager
+#            sweeps the orphaned staging dir), a torn-publish hot-swap
+#            scenario (watcher must quarantine the corrupt step and
+#            keep serving the previous verified one, zero dropped
+#            requests), and a batcher flood (sheds counted, accepted
+#            requests all complete, tail bounded by the queue depth)
 #   spmd -> one-program multi-host gate (docs/distributed.md): a REAL
 #           2-process gloo smoke train through tools/launch.py -- the
 #           dist train step must be ONE compiled SPMD program whose
@@ -71,7 +81,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -292,7 +302,7 @@ EOF
     JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py \
-        tests/test_serving.py -q
+        tests/test_serving.py tests/test_chaos.py -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
     # paths (ISSUE 9) run with the lock sanitizer armed end to end
@@ -640,6 +650,105 @@ print("serving gate ok: %d requests, occupancy %.2f, p99 %.1fms"
       % (sv["requests"], sv["mean_occupancy"], 1e3 * sv["latency_p99_s"]))
 EOF
     rm -rf "$svjsonl" "$svjsonl.agg" "$svcache"
+}
+
+run_chaos() {
+    log "chaos: deterministic fault-injection tests (quick tier)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q -m 'not slow'
+    chdir=$(mktemp -d /tmp/mxtpu_chaos_ci.XXXXXX)
+    log "chaos: REAL kill-mid-commit (seed 0) -> one-step rollback gate"
+    # phase 1: a trainer publishing every step dies SIGKILL-shaped
+    # (os._exit 137) between the staged data files and the manifest
+    # commit of step 3 -- the staged dir must never become loadable
+    set +e
+    JAX_PLATFORMS=cpu python - "$chdir" <<'EOF'
+import sys
+import mxnet_tpu as mx
+from mxnet_tpu import chaos
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import ContinuousTrainer
+
+net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+ct = ContinuousTrainer(net, trainer, loss_fn, data,
+                       sys.argv[1] + "/ckpts", publish_every=1)
+chaos.arm(seed=0)
+chaos.on("checkpoint.commit.pre_manifest", nth=3, action=chaos.KILL)
+ct.run_steps(3)                         # dies mid-commit of step 3
+raise SystemExit("chaos KILL did not fire")
+EOF
+    rc=$?
+    set -e
+    [ "$rc" -eq 137 ] || { echo "expected exit 137, got $rc"; exit 1; }
+    # phase 2: a fresh process (the restarted job + the serving side)
+    # must see step 2 as the newest verified step, sweep the orphaned
+    # staging dir, and hot-swap the servable to step 2
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 python - "$chdir" <<'EOF'
+import os, sys
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import RegistryWatcher
+
+root = sys.argv[1] + "/ckpts"
+assert any(d.endswith(".tmp") for d in os.listdir(root)), \
+    "kill left no staging dir -- the scenario tested nothing"
+mgr = mx.checkpoint.CheckpointManager(root)     # init sweeps dead tmps
+assert not any(d.endswith(".tmp") for d in os.listdir(root))
+assert mgr.latest_step() == 2, mgr.all_steps()
+reg = serving.ModelRegistry(compile_cache=False)
+watcher = RegistryWatcher(reg, "model", mgr, scenarios.make_mlp(),
+                          input_shape=(8,), buckets=(1, 2),
+                          max_wait_ms=2)
+assert watcher.poll_once() == 2
+assert telemetry.counter("serving.swaps").value == 1
+import numpy as np
+out = reg.infer("model", np.zeros(8, np.float32), timeout=30)
+assert out is not None
+reg.shutdown(drain=True); watcher.close()
+print("kill-mid-commit gate ok: rolled back to step 2, tmp swept, "
+      "servable swapped")
+EOF
+    log "chaos: torn-publish hot-swap scenario (seed 0) -> quarantine + zero dropped"
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 python - "$chdir" <<'EOF'
+import sys
+from mxnet_tpu import telemetry
+from mxnet_tpu.chaos import scenarios
+
+rep = scenarios.hotswap_scenario(sys.argv[1] + "/torn", torn=True,
+                                 seed=0)
+assert rep["second_swap_step"] is None, rep
+assert rep["served_step"] == 2, rep             # the rollback gate
+assert rep["quarantined"] == ["step_00000004.corrupt"], rep
+assert rep["errors"] == [] and rep["shed"] == 0, rep
+assert rep["completed"] == rep["requests"] > 0, rep   # zero dropped
+assert rep["completed_after_swap"] >= 1, rep
+assert rep["chaos"]["injected"]["checkpoint.commit.post_commit"] == 1
+assert telemetry.counter("checkpoint.quarantined").value == 1
+assert telemetry.counter("chaos.injected").value == 1
+assert telemetry.counter("chaos.survived").value >= 1
+print("torn-publish gate ok: quarantined, served step %d, "
+      "%d/%d requests completed"
+      % (rep["served_step"], rep["completed"], rep["requests"]))
+EOF
+    log "chaos: batcher flood scenario (seed 0) -> shed counted, tail bounded"
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 python - <<'EOF'
+from mxnet_tpu import telemetry
+from mxnet_tpu.chaos import scenarios
+
+rep = scenarios.flood_scenario(seed=0, max_queue=4, clients=8,
+                               per_client=8, hold_s=0.03)
+assert rep["shed"] > 0, "flood did not overflow the bounded queue"
+assert rep["errors"] == [], rep["errors"]       # sheds are DISTINCT
+assert rep["completed"] + rep["shed"] == rep["requests"], rep
+assert rep["completed"] > 0, rep                # in-flight completed
+assert rep["max_latency_s"] < rep["latency_bound_s"], rep
+assert telemetry.counter("serving.shed").value == rep["shed"]
+print("flood gate ok: %d sheds, %d completed, max latency %.0fms "
+      "(bound %.0fms)"
+      % (rep["shed"], rep["completed"], 1e3 * rep["max_latency_s"],
+         1e3 * rep["latency_bound_s"]))
+EOF
+    rm -rf "$chdir"
 }
 
 run_kernels() {

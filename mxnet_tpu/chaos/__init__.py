@@ -1,0 +1,42 @@
+"""Chaos harness: deterministic fault injection for the always-on loop
+(ISSUE 12).
+
+Every robustness mechanism in the tree -- atomic checkpoint commits
+with corruption-tolerant discovery, the draining hot-swap registry,
+async-write retries, preemption saves, batcher load-shedding -- existed
+without anything ever *injecting* the fault it guards against.  This
+package is the weather machine:
+
+- **fail points** (``chaos.fail_point(name)``, ``core.py``): named
+  hooks compiled into the dangerous spots (checkpoint commit, serving
+  dispatch, the hot-swap install, the preemption signal path).
+  Disarmed they are one module-flag check; armed, seeded rules decide
+  deterministically which hit dies, and how (``RAISE``, ``KILL``,
+  ``sleep``, ``truncate``, any callable);
+- **scenarios** (``scenarios.py``): the composed experiments tests,
+  CI's ``chaos`` stage, and ``bench_serving_hotswap`` share --
+  continuous-train -> hot-swap under client load (with an optional
+  torn publish), and a flood past the bounded serving queue;
+- **accounting**: every injected fault counts
+  (``chaos.injected.<point>``) and every tolerated one -- injected or
+  real -- is recorded by the recovery path itself
+  (``chaos.survived.<point>``), so "we survived N faults" is a
+  queryable claim, not a vibe.
+
+Fail-point catalogue, seeding rules, and how to add a point:
+``docs/chaos.md``.
+"""
+from __future__ import annotations
+
+from .core import (KILL, RAISE, ChaosInjected, arm, armed, disarm,
+                   fail_point, on, reset, scenario, sleep, stats,
+                   survived, truncate)
+
+__all__ = [
+    "ChaosInjected", "arm", "disarm", "armed", "reset", "on",
+    "fail_point", "survived", "stats", "scenario",
+    "RAISE", "KILL", "sleep", "truncate",
+    "scenarios",
+]
+
+from . import scenarios  # noqa: E402  (uses the core surface above)

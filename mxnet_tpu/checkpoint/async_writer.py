@@ -14,9 +14,16 @@ on):
 - **at-most-one-in-flight** -- a new save first drains the previous
   one, so checkpoints land in order and host memory holds at most one
   extra copy of the state;
-- **errors are never swallowed** -- a writer failure is stored and
-  re-raised at the *next* ``save()``/``wait_until_finished()``, the
-  spots a training loop actually checks;
+- **transient weather is retried** -- a failed background write (a
+  full disk blip, an NFS hiccup, an injected chaos fault) retries up
+  to ``MXNET_TPU_CKPT_WRITE_RETRIES`` times with exponential backoff
+  (``MXNET_TPU_CKPT_RETRY_BACKOFF_S`` doubling per attempt); retries
+  are counted (``checkpoint.write_retries``);
+- **errors are never swallowed** -- a write that fails every attempt
+  is surfaced through the ``checkpoint.write_failed`` telemetry event
+  (+ ``checkpoint.write_failures`` counter) AND stored for re-raise at
+  the *next* ``save()``/``wait_until_finished()``, the spots a
+  training loop actually checks;
 - ``wait_until_finished()`` is the durability barrier: after it
   returns, the bytes are committed.
 """
@@ -27,6 +34,7 @@ import time
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -73,10 +81,16 @@ def snapshot_items(items):
 class AsyncWriter:
     """Background committer with the at-most-one-in-flight contract."""
 
-    def __init__(self):
+    def __init__(self, retries=None, backoff_s=None):
+        from .. import env as _env
         self._thread = None
         self._error = None
         self._lock = _sync.Lock(name="checkpoint.async_writer")
+        self._retries = int(retries if retries is not None
+                            else _env.get("MXNET_TPU_CKPT_WRITE_RETRIES"))
+        self._backoff_s = float(
+            backoff_s if backoff_s is not None
+            else _env.get("MXNET_TPU_CKPT_RETRY_BACKOFF_S"))
 
     # -- error propagation --------------------------------------------
     def check(self):
@@ -102,11 +116,36 @@ class AsyncWriter:
             gate = _TEST_WRITE_GATE
             if gate is not None:
                 gate.wait()
-            try:
-                fn()
-            except BaseException as e:  # noqa: B036 -- must cross threads
-                with self._lock:
-                    self._error = e
+            attempts = self._retries + 1
+            for attempt in range(1, attempts + 1):
+                try:
+                    _chaos.fail_point("checkpoint.async_write",
+                                      step=step, attempt=attempt)
+                    fn()
+                except BaseException as e:  # noqa: B036 -- cross threads
+                    if attempt < attempts:
+                        # transient weather: back off and retry; the
+                        # staged dir is re-created from scratch so a
+                        # partial attempt can't poison the next one
+                        if _telemetry._ENABLED:
+                            _telemetry.hooks.checkpoint_retry(
+                                attempt, str(e), step=step)
+                        time.sleep(self._backoff_s
+                                   * (2 ** (attempt - 1)))
+                        continue
+                    # exhausted: surface loudly (telemetry event) AND
+                    # store for the next save()/wait() to re-raise --
+                    # never a swallowed thread exception
+                    if _telemetry._ENABLED:
+                        _telemetry.hooks.checkpoint_write_failed(
+                            attempts, str(e), step=step)
+                    with self._lock:
+                        self._error = e
+                else:
+                    if attempt > 1:
+                        _chaos.survived("checkpoint.async_write",
+                                        "retry")
+                    return
 
         self._thread = threading.Thread(
             target=_run, name="mxnet_tpu-ckpt-writer", daemon=True)

@@ -36,6 +36,7 @@ import time
 import warnings
 import zlib
 
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 
@@ -324,10 +325,15 @@ class CheckpointManager:
       a writer error re-raises at the next ``save``/``wait``.
     - ``sharded`` (default: auto = multi-process runs): each process
       writes only its addressable shards; see ``checkpoint/sharded.py``.
+    - ``quarantine`` (``MXNET_TPU_CKPT_QUARANTINE``, default on): a
+      step that fails verification during :meth:`latest_step` discovery
+      is renamed ``step_<N>.corrupt`` (and counted in
+      ``checkpoint.quarantined``) instead of silently skipped, so
+      operators can see rollbacks happened and keep the evidence.
     """
 
     def __init__(self, root, max_to_keep=None, keep_every_n_steps=None,
-                 async_save=None, sharded=None):
+                 async_save=None, sharded=None, quarantine=None):
         from .. import env as _env
         self.root = os.fspath(root)
         if max_to_keep is None:
@@ -336,6 +342,9 @@ class CheckpointManager:
             max_to_keep = None
         self.max_to_keep = max_to_keep
         self.keep_every_n_steps = keep_every_n_steps or None
+        if quarantine is None:
+            quarantine = _env.get("MXNET_TPU_CKPT_QUARANTINE")
+        self.quarantine = bool(quarantine)
         if async_save is None:
             async_save = _env.get("MXNET_TPU_CKPT_ASYNC")
         self._sharded = sharded
@@ -381,14 +390,37 @@ class CheckpointManager:
             return None
         return manifest
 
+    def _quarantine_step(self, step):
+        """Rename a verification-failed step dir to ``<dir>.corrupt``
+        so the rollback is visible to operators (and the torn bytes
+        stay available as evidence).  Tolerant of a concurrent writer
+        re-saving the step or another process quarantining first;
+        rank 0 only under multi-process layouts."""
+        if not self.quarantine or _topology()["process_id"] != 0:
+            return False
+        src = self.step_dir(step)
+        dst = src + ".corrupt"
+        try:
+            if os.path.isdir(dst):
+                shutil.rmtree(dst, ignore_errors=True)
+            os.replace(src, dst)
+        except OSError:
+            return False
+        if _telemetry._ENABLED:
+            _telemetry.hooks.checkpoint_quarantine(step, dst)
+        _chaos.survived("checkpoint.commit", "quarantine")
+        return True
+
     def latest_step(self):
         """Newest step that passes manifest + checksum verification, or
         None.  A torn/corrupted newest step falls back to the previous
         good one -- the property the atomic commit protocol exists
-        for."""
+        for -- and is quarantined (renamed ``.corrupt``) rather than
+        silently skipped, so the rollback is observable."""
         for step in reversed(self.all_steps()):
             if self._verify_step(step) is not None:
                 return step
+            self._quarantine_step(step)
         return None
 
     # -- save ----------------------------------------------------------
@@ -466,6 +498,11 @@ class CheckpointManager:
                 json.dump(manifest, f, indent=1, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
+        # chaos: a KILL here is the canonical kill-mid-commit -- data
+        # files staged, manifest absent -- which must cost at most one
+        # step, never the job (tests/test_chaos.py, ci chaos stage)
+        _chaos.fail_point("checkpoint.commit.pre_manifest", step=step,
+                          path=staging)
         # manifest LAST: its presence asserts every data file above it
         # is complete, so the rename below publishes all-or-nothing
         commit(os.path.join(staging, MANIFEST_NAME), _write_manifest)
@@ -475,6 +512,11 @@ class CheckpointManager:
         os.replace(staging, final)
         _fsync_dir(self.root)
         sweep_stale_tmps(self.root)
+        # chaos: corruption AFTER the atomic publish models bit-rot or
+        # a non-atomic foreign writer -- what manifest verification and
+        # quarantine exist to catch
+        _chaos.fail_point("checkpoint.commit.post_commit", step=step,
+                          path=final)
         return total
 
     def _apply_retention(self):

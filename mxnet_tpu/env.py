@@ -255,6 +255,53 @@ _VARS = [
            "analysis.perf.diff_audit).  A metric grown past baseline + "
            "tolerance errors naming the executable; improvements pass "
            "(docs/perf_lint.md)."),
+    EnvVar("MXNET_TPU_CKPT_QUARANTINE", bool, True,
+           "Checkpoint discovery quarantine: a step that fails "
+           "manifest/CRC verification during "
+           "CheckpointManager.latest_step() is renamed "
+           "step_<N>.corrupt (counted in checkpoint.quarantined) "
+           "instead of silently skipped, so rollbacks are visible to "
+           "operators and the torn bytes survive as evidence.  '0' "
+           "restores skip-only discovery.  Per-manager override: "
+           "CheckpointManager(quarantine=...)."),
+    EnvVar("MXNET_TPU_CKPT_WRITE_RETRIES", int, 2,
+           "How many times the async checkpoint writer retries a "
+           "failed background write (exponential backoff from "
+           "MXNET_TPU_CKPT_RETRY_BACKOFF_S) before surfacing the "
+           "error through the checkpoint.write_failed telemetry event "
+           "and the next save()/wait_until_finished().  0 disables "
+           "retries.  Per-writer override: AsyncWriter(retries=...)."),
+    EnvVar("MXNET_TPU_CKPT_RETRY_BACKOFF_S", float, 0.25,
+           "Initial backoff (seconds) between async checkpoint write "
+           "retries; doubles per attempt."),
+    EnvVar("MXNET_TPU_CHAOS_SEED", int, 0,
+           "Default seed for mx.chaos.arm(): per-rule probability "
+           "streams derive from (seed, fail point, rule index), so a "
+           "chaos scenario replays identically for a fixed seed.  "
+           "Chaos is only ever armed programmatically "
+           "(chaos.arm()/chaos.scenario()); no env var can arm fail "
+           "points in a production process."),
+    EnvVar("MXNET_TPU_SERVING_POLL_S", float, 0.5,
+           "RegistryWatcher poll interval (seconds): how often the "
+           "checkpoint root is scanned for a newer verified step to "
+           "hot-swap into the servable.  Per-watcher override: "
+           "RegistryWatcher(poll_s=...)."),
+    EnvVar("MXNET_TPU_SERVING_SWAP_RETRIES", int, 2,
+           "How many times a RegistryWatcher retries an aborted "
+           "hot-swap (exponential backoff from "
+           "MXNET_TPU_SERVING_SWAP_BACKOFF_S) before marking the step "
+           "bad and keeping the previous model in service.  "
+           "Per-watcher override: RegistryWatcher(swap_retries=...)."),
+    EnvVar("MXNET_TPU_SERVING_SWAP_BACKOFF_S", float, 0.25,
+           "Initial backoff (seconds) between hot-swap retries; "
+           "doubles per attempt."),
+    EnvVar("MXNET_TPU_SERVING_SWAP_BUDGET", int, 3,
+           "RegistryWatcher failure budget: after this many "
+           "CONSECUTIVE steps fail to swap (each already retried), "
+           "the watcher suspends itself with a warning instead of "
+           "flapping -- the last good model keeps serving until an "
+           "operator intervenes.  Per-watcher override: "
+           "RegistryWatcher(failure_budget=...)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -28,6 +28,7 @@ import signal
 import threading
 import warnings
 
+from . import chaos as _chaos
 from . import sync as _sync
 from . import telemetry as _telemetry
 from .base import MXNetError
@@ -69,6 +70,7 @@ class PreemptionHandler:
         self._fallback_saved = False
         self._signal_seen = False
         self._saving = False
+        self._in_handler = False
         # RLock: the SIGTERM handler runs on the same thread and may
         # interrupt an explicit save_now() call mid-save
         self._lock = _sync.RLock(name="preemption.handler")
@@ -191,8 +193,30 @@ class PreemptionHandler:
                 self._saving = False
 
     def _on_signal(self, signum, frame):
-        self._signal_seen = True
+        # Re-entrancy guard: Python delivers a second SIGTERM by
+        # running this handler NESTED on the same thread, at an
+        # arbitrary bytecode boundary -- possibly while save_now() is
+        # mid-commit (save_in_handler, or a signal landing during the
+        # boundary save that a `triggered` read started).  Without the
+        # guard the nested handler would re-enter save_now through the
+        # RLock and interleave a second commit into the first one's
+        # tmp-file dance, tearing the provisional save with its own
+        # handler.  A re-entrant delivery only records the signal; the
+        # outer save already in flight is the one that lands.
+        if self._in_handler or self._saving:
+            self._signal_seen = True
+            if _telemetry._ENABLED:
+                _telemetry.hooks.preemption_reentry()
+            _chaos.survived("preemption.signal", "reentrant-suppressed")
+            return
+        self._in_handler = True
         try:
+            self._signal_seen = True
+            # chaos: a rule here can deliver a nested signal (callable
+            # action invoking _on_signal again) or stall the handler --
+            # how tests prove the guard above holds
+            _chaos.fail_point("preemption.signal", signum=signum,
+                              handler=self)
             if self.save_in_handler:
                 self.save_now()
             elif self.fallback_after is not None \
@@ -203,6 +227,7 @@ class PreemptionHandler:
                 t.start()
                 self._fallback_timer = t
         finally:
+            self._in_handler = False
             prev = self._prev.get(signum)
             if callable(prev):
                 prev(signum, frame)

@@ -59,6 +59,10 @@ def _detect():
         # optimizer + interpret-mode kernels off-TPU); auto mode still
         # selects profitable kernels on TPU with this row False
         "KERNELS": _kernels_armed(),
+        # chaos fault injection (mx.chaos): LIVE arm state -- True only
+        # inside a chaos.arm()/chaos.scenario() window, never in a
+        # production process (no env var arms it)
+        "CHAOS": _chaos_armed(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -81,6 +85,11 @@ def _profiling_enabled():
 def _kernels_armed():
     from . import kernels
     return kernels.mode() == "on"
+
+
+def _chaos_armed():
+    from . import chaos
+    return chaos.armed()
 
 
 def _shard_check_enabled():

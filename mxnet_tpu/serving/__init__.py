@@ -19,7 +19,14 @@ into an inference engine:
 - **SLO telemetry**: ``serving.*`` instruments (request latency with
   p50/p95/p99, QPS, batch occupancy, queue depth, shed/timeout counts)
   through ``mx.telemetry``, summarized by the CLI's ``serving``
-  section; ``bench.py::bench_serving`` emits the latency-vs-QPS curve.
+  section; ``bench.py::bench_serving`` emits the latency-vs-QPS curve;
+- **the always-on loop** (``loop.py``): ``ContinuousTrainer`` publishes
+  atomic checkpoints while ``RegistryWatcher`` discovers each new
+  *verified* step and hot-swaps the servable with zero dropped
+  requests (drain-then-replace, warm pre-compile, retry/backoff under
+  a failure budget) -- proven under the chaos harness
+  (``mx.chaos``, docs/chaos.md); ``bench.py::bench_serving_hotswap``
+  records swap latency and p99-during-swap.
 
 ::
 
@@ -39,10 +46,12 @@ from .batcher import (DynamicBatcher, RequestTimeout, ServableClosed,
                       ServingQueueFull)
 from .cache import CompileCache, stablehlo_fingerprint
 from .executor import BucketExecutorPool
+from .loop import ContinuousTrainer, RegistryWatcher
 from .registry import ModelRegistry, Servable
 
 __all__ = [
     "ModelRegistry", "Servable", "DynamicBatcher", "BucketExecutorPool",
     "CompileCache", "stablehlo_fingerprint",
+    "ContinuousTrainer", "RegistryWatcher",
     "ServingQueueFull", "RequestTimeout", "ServableClosed",
 ]

@@ -29,6 +29,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -180,6 +181,11 @@ class DynamicBatcher:
             batch[i] = r.x
         t0 = time.perf_counter()
         try:
+            # chaos: a sleep rule here is the wedged-device weather the
+            # flood scenario sheds against; a RAISE rule proves a
+            # failed dispatch fails its requests, not the worker
+            _chaos.fail_point("serving.dispatch", model=self._label,
+                              occupancy=n, bucket=bucket)
             outs = self._pool.call(bucket, batch)
             outs = jax.device_get(outs)       # one gather for the batch
         except Exception as e:                # compiled call failed:

@@ -25,9 +25,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import chaos as _chaos
 from .. import telemetry as _telemetry
 from ..base import MXNetError
-from .batcher import DynamicBatcher
+from .batcher import DynamicBatcher, ServableClosed
 from .cache import CompileCache
 from .executor import BucketExecutorPool
 
@@ -177,6 +178,11 @@ class ModelRegistry:
                                   cache=self._cache, label=name)
         if warmup:
             pool.warmup()
+        # chaos: an abort here (after the expensive warm-up, before the
+        # install) models every way a swap dies late; the previous
+        # servable MUST keep serving untouched -- the watcher's
+        # retry/backoff and failure budget hang off this contract
+        _chaos.fail_point("serving.swap", model=name)
         batcher = DynamicBatcher(pool, label=name, max_wait_ms=max_wait_ms,
                                  max_queue=max_queue)
         servable = Servable(name, pool, batcher, source)
@@ -275,10 +281,28 @@ class ModelRegistry:
             return sorted(self._servables)
 
     def submit(self, name, x, timeout=None):
-        return self.servable(name).submit(x, timeout=timeout)
+        """Queue one sample on the named servable.  A concurrent
+        re-register (hot swap) can close the handle between the lookup
+        and the submit; the replacement is already installed by then,
+        so the lookup retries against it -- a swap is invisible to
+        registry-path clients (zero dropped requests, proven under
+        chaos in tests/test_chaos.py)."""
+        for _ in range(8):
+            s = self.servable(name)
+            try:
+                return s.submit(x, timeout=timeout)
+            except ServableClosed:
+                with self._lock:
+                    cur = self._servables.get(name)
+                if cur is None or cur is s:
+                    raise               # really closed, not swapped
+        raise ServableClosed(
+            "serving: servable %r kept closing mid-submit (flapping "
+            "re-registration?)" % name)
 
     def infer(self, name, x, timeout=None):
-        return self.servable(name).infer(x, timeout=timeout)
+        fut = self.submit(name, x, timeout=timeout)
+        return fut.result(timeout=timeout)
 
     # -- lifecycle ------------------------------------------------------
     def unregister(self, name, drain=True):

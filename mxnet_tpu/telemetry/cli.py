@@ -207,6 +207,8 @@ def _serving_section(counters, timers):
         "latency_p95_s": lat.get("p95"),
         "latency_p99_s": lat.get("p99"),
         "latency_mean_s": lat.get("mean"),
+        "swaps": counters.get("serving.swaps", 0),
+        "swap_failures": counters.get("serving.swap_failures", 0),
         "compile_cache_hits": counters.get("serving.compile_cache_hits",
                                            0),
         "compile_cache_misses":

@@ -106,6 +106,36 @@ serving.compile_cache_misses
 serving.compile_evictions
                        counter Predictor per-shape jit programs
                                evicted by the LRU bound
+serving.swaps          counter successful hot-swaps (RegistryWatcher
+                               re-register to a newer verified step)
+serving.swap_failures  counter swap attempts that aborted (previous
+                               servable kept serving)
+serving.swap_time      timer   wall per successful swap (restore +
+                               warm-up + install + old-servable drain)
+serving.served_step    gauge   checkpoint step the live servable was
+                               loaded from
+train_loop.publishes   counter checkpoints published by
+                               ContinuousTrainer
+train_loop.published_step
+                       gauge   newest step the trainer published
+checkpoint.quarantined counter verification-failed steps renamed to
+                               step_<N>.corrupt during discovery (each
+                               is a rollback an operator should see)
+checkpoint.write_retries
+                       counter async-writer attempts retried after a
+                               transient failure (exp backoff)
+checkpoint.write_failures
+                       counter async writes that failed EVERY attempt
+                               (error also re-raises at next save/wait)
+preemption.reentrant_signals
+                       counter re-entrant SIGTERM deliveries suppressed
+                               while a save was mid-commit
+chaos.injected         counter faults injected by armed fail points
+                               (chaos.injected.<point> per point)
+chaos.survived         counter faults tolerated by a recovery path --
+                               quarantine, write retry, swap rollback,
+                               re-entrant-signal suppression
+                               (chaos.survived.<point> per point)
 =====================  ======  =========================================
 """
 from __future__ import annotations
@@ -120,6 +150,9 @@ __all__ = [
     "serving_request", "serving_shed", "serving_timeout",
     "serving_batch", "serving_latency", "serving_warmup",
     "serving_model", "serving_compile_cache", "serving_evict",
+    "serving_swap", "train_publish", "checkpoint_quarantine",
+    "checkpoint_retry", "checkpoint_write_failed",
+    "preemption_reentry", "chaos_inject", "chaos_survive",
 ]
 
 
@@ -340,3 +373,71 @@ def serving_compile_cache(hit):
 
 def serving_evict():
     _registry().counter("serving.compile_evictions").inc()
+
+
+def serving_swap(model, step, seconds, ok, from_step=None, attempt=1,
+                 error=None):
+    """One hot-swap attempt by a RegistryWatcher finished."""
+    reg = _registry()
+    if ok:
+        reg.counter("serving.swaps").inc()
+        reg.timer("serving.swap_time").observe(seconds, model=model,
+                                               step=step)
+        reg.gauge("serving.served_step").set(step)
+    else:
+        reg.counter("serving.swap_failures").inc()
+    reg.event("serving.swap").emit(model=model, step=step, ok=bool(ok),
+                                   from_step=from_step, attempt=attempt,
+                                   seconds=seconds, error=error)
+
+
+def train_publish(step, seconds):
+    """ContinuousTrainer published a checkpoint for the watcher."""
+    reg = _registry()
+    reg.counter("train_loop.publishes").inc()
+    reg.gauge("train_loop.published_step").set(step)
+    reg.event("train_loop.publish").emit(step=step, seconds=seconds)
+
+
+def checkpoint_quarantine(step, path):
+    """Discovery renamed a verification-failed step to .corrupt."""
+    reg = _registry()
+    reg.counter("checkpoint.quarantined").inc()
+    reg.event("checkpoint.quarantine").emit(step=step, path=path)
+
+
+def checkpoint_retry(attempt, error, step=None):
+    """The async writer retried a failed background write."""
+    reg = _registry()
+    reg.counter("checkpoint.write_retries").inc()
+    reg.event("checkpoint.write_retry").emit(attempt=attempt,
+                                             error=error, step=step)
+
+
+def checkpoint_write_failed(attempts, error, step=None):
+    """An async write failed every attempt (error re-raises at the
+    next save/wait; this event is the operator-visible surface)."""
+    reg = _registry()
+    reg.counter("checkpoint.write_failures").inc()
+    reg.event("checkpoint.write_failed").emit(attempts=attempts,
+                                              error=error, step=step)
+
+
+def preemption_reentry():
+    _registry().counter("preemption.reentrant_signals").inc()
+
+
+def chaos_inject(point, action):
+    """An armed fail point fired."""
+    reg = _registry()
+    reg.counter("chaos.injected").inc()
+    reg.counter("chaos.injected." + point).inc()
+    reg.event("chaos.inject").emit(point=point, action=action)
+
+
+def chaos_survive(point, how):
+    """A recovery path tolerated a fault (injected or real)."""
+    reg = _registry()
+    reg.counter("chaos.survived").inc()
+    reg.counter("chaos.survived." + point).inc()
+    reg.event("chaos.survive").emit(point=point, how=how)

@@ -31,6 +31,14 @@ def bench_mod(monkeypatch):
                             {"offered_qps": 100, "qps": 99.0,
                              "p50_ms": 3.0, "p95_ms": 5.0, "p99_ms": 7.0,
                              "mean_occupancy": 2.5, "shed": 0}])
+    monkeypatch.setattr(bench, "bench_serving_hotswap",
+                        lambda *a, **k: {
+                            "swap_step": 4, "swap_latency_ms": 120.0,
+                            "p50_steady_ms": 3.0, "p99_steady_ms": 7.0,
+                            "p50_during_swap_ms": 3.5,
+                            "p99_during_swap_ms": 9.0,
+                            "requests": 1000,
+                            "requests_during_swap": 80, "dropped": 0})
     monkeypatch.setattr(bench, "bench_lenet_imperative",
                         lambda *a, **k: 25000.0)
     monkeypatch.setattr(bench, "bench_resnet50", lambda *a, **k: 1500.0)
@@ -270,6 +278,34 @@ def test_serving_curve_emits(bench_mod, capsys):
     for key in ("offered_qps", "qps", "p50_ms", "p95_ms", "p99_ms",
                 "mean_occupancy", "shed"):
         assert key in level, key
+
+
+def test_serving_hotswap_line_emits(bench_mod, capsys):
+    """ISSUE 12 bench contract: the hot-swap line carries swap latency,
+    p99-during-swap vs steady, and the zero-dropped count."""
+    bench_mod.main()
+    _metrics_list, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    rec = by["serving_hotswap"]
+    assert rec["unit"] == "ms"
+    for key in ("swap_step", "swap_latency_ms", "p99_during_swap_ms",
+                "p99_steady_ms", "p50_during_swap_ms", "p50_steady_ms",
+                "requests_during_swap", "dropped"):
+        assert key in rec, key
+    assert rec["dropped"] == 0
+
+
+def test_hotswap_bench_uses_product_loop(monkeypatch):
+    """Source contract on the UNPATCHED module: the hot-swap bench
+    drives the PRODUCT always-on loop (ContinuousTrainer publishing
+    checkpoints + RegistryWatcher re-registering), not bench-local
+    scaffolding."""
+    import inspect
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    src = inspect.getsource(bench.bench_serving_hotswap)
+    assert "ContinuousTrainer" in src and "RegistryWatcher" in src
+    assert "poll_once" in src
 
 
 def test_multichip_scaling_line_emits(bench_mod, capsys):

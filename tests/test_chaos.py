@@ -1,0 +1,490 @@
+"""Chaos harness + always-on loop tests (ISSUE 12): deterministic fail
+points, checkpoint quarantine, async-write retry, preemption re-entrancy,
+batcher flood shedding, and the continuous-train -> hot-swap loop under
+injected faults (zero dropped requests across a swap; kill-mid-commit
+rolls the watcher back to the previous verified step)."""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, gluon, serving, telemetry
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.checkpoint.async_writer import AsyncWriter
+from mxnet_tpu.serving.loop import ContinuousTrainer, RegistryWatcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def counters():
+    telemetry.enable()
+    yield telemetry
+    telemetry.disable()
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.disarm()
+    chaos.reset()
+
+
+def _loop_parts(tmp_path, publish_every=2):
+    net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+    ct = ContinuousTrainer(net, trainer, loss_fn, data,
+                           str(tmp_path / "ck"),
+                           publish_every=publish_every)
+    return net, ct
+
+
+# ---------------------------------------------------------------------
+# fail-point core
+# ---------------------------------------------------------------------
+
+def test_fail_point_disarmed_is_noop():
+    chaos.on("never", action=chaos.RAISE)   # rule present, not armed
+    chaos.fail_point("never")               # must not fire
+    assert chaos.stats()["hits"] == {}      # disarmed: not even counted
+
+
+def test_nth_rule_fires_deterministically():
+    with chaos.scenario(seed=3):
+        chaos.on("pt", nth=(2, 3))
+        chaos.fail_point("pt")
+        for _ in range(2):
+            with pytest.raises(chaos.ChaosInjected):
+                chaos.fail_point("pt")
+        chaos.fail_point("pt")              # hit 4: clean
+    st = chaos.stats()
+    assert st["hits"]["pt"] == 4 and st["injected"]["pt"] == 2
+
+
+def test_prob_rule_replays_identically_for_a_seed():
+    def run(seed):
+        fired = []
+        with chaos.scenario(seed=seed):
+            chaos.on("p", prob=0.5)
+            for i in range(32):
+                try:
+                    chaos.fail_point("p")
+                    fired.append(False)
+                except chaos.ChaosInjected:
+                    fired.append(True)
+        return fired
+
+    a, b = run(7), run(7)
+    assert a == b and any(a) and not all(a)
+    assert run(8) != a                      # a different seed differs
+
+
+def test_times_caps_fires():
+    with chaos.scenario(seed=0):
+        chaos.on("cap", times=1)
+        with pytest.raises(chaos.ChaosInjected):
+            chaos.fail_point("cap")
+        chaos.fail_point("cap")             # capped: clean
+    assert chaos.stats()["injected"]["cap"] == 1
+
+
+def test_injection_counts_in_telemetry(counters):
+    telemetry.reset("chaos.")
+    with chaos.scenario(seed=0):
+        chaos.on("t", times=1)
+        with pytest.raises(chaos.ChaosInjected):
+            chaos.fail_point("t")
+    chaos.survived("t", "test")
+    assert telemetry.counter("chaos.injected").value == 1
+    assert telemetry.counter("chaos.injected.t").value == 1
+    assert telemetry.counter("chaos.survived.t").value == 1
+
+
+# ---------------------------------------------------------------------
+# checkpoint: quarantine (satellite) + kill-mid-commit
+# ---------------------------------------------------------------------
+
+def _two_steps(tmp_path, **kwargs):
+    mgr = CheckpointManager(str(tmp_path / "ck"), **kwargs)
+    mgr.save(1, {"blob": b"one"})
+    mgr.save(2, {"blob": b"two"})
+    return mgr
+
+def test_torn_newest_step_is_quarantined(tmp_path, counters):
+    telemetry.reset("checkpoint.")
+    mgr = _two_steps(tmp_path)
+    with open(os.path.join(mgr.step_dir(2), "blob.bin"), "r+b") as f:
+        f.truncate(1)
+    with pytest.warns(RuntimeWarning, match="failed verification"):
+        assert mgr.latest_step() == 1
+    # renamed, not silently skipped: evidence survives, discovery is
+    # clean on the next poll (no re-warn), and the counter records it
+    assert not os.path.isdir(mgr.step_dir(2))
+    assert os.path.isdir(mgr.step_dir(2) + ".corrupt")
+    assert mgr.all_steps() == [1]
+    assert telemetry.counter("checkpoint.quarantined").value == 1
+    assert mgr.restore().step == 1
+
+
+def test_quarantine_off_keeps_skip_only_discovery(tmp_path):
+    mgr = _two_steps(tmp_path, quarantine=False)
+    os.remove(os.path.join(mgr.step_dir(2), "manifest.json"))
+    with pytest.warns(RuntimeWarning):
+        assert mgr.latest_step() == 1
+    assert os.path.isdir(mgr.step_dir(2))   # left in place
+
+
+def test_chaos_truncate_action_tears_a_committed_step(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with chaos.scenario(seed=0):
+        chaos.on("checkpoint.commit.post_commit", nth=2,
+                 action=chaos.truncate("blob.bin", keep=1))
+        mgr.save(1, {"blob": b"step-one"})
+        mgr.save(2, {"blob": b"step-two"})  # torn after the commit
+    with pytest.warns(RuntimeWarning):
+        assert mgr.latest_step() == 1
+    assert chaos.stats()["injected"] == \
+        {"checkpoint.commit.post_commit": 1}
+    assert chaos.stats()["survived"] == {"checkpoint.commit": 1}
+
+
+@pytest.mark.slow
+def test_kill_mid_commit_subprocess_costs_one_step(tmp_path):
+    """A REAL kill (os._exit, SIGKILL-shaped) between the data files
+    and the manifest commit: the staged step must never become
+    loadable, discovery lands on the previous step, and the next
+    manager sweeps the orphaned staging dir."""
+    root = str(tmp_path / "ck")
+    code = (
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import chaos\n"
+        "mgr = mx.checkpoint.CheckpointManager(%r)\n"
+        "chaos.arm(seed=0)\n"
+        "chaos.on('checkpoint.commit.pre_manifest', nth=2,\n"
+        "         action=chaos.KILL)\n"
+        "mgr.save(1, {'blob': b'one'})\n"
+        "mgr.save(2, {'blob': b'two'})\n"   # dies here
+        "raise SystemExit('kill did not fire')\n" % root)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 137, (out.returncode, out.stderr[-500:])
+    leftover = [d for d in os.listdir(root) if d.endswith(".tmp")]
+    assert leftover, "expected an orphaned staging dir"
+    mgr = CheckpointManager(root)           # init sweeps dead-pid tmps
+    assert mgr.latest_step() == 1
+    assert not any(d.endswith(".tmp") for d in os.listdir(root))
+
+
+# ---------------------------------------------------------------------
+# async writer: bounded retry + surfaced failure (satellite)
+# ---------------------------------------------------------------------
+
+def test_async_write_retries_then_lands(tmp_path, counters):
+    telemetry.reset("checkpoint.")
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr._writer = AsyncWriter(retries=2, backoff_s=0.01)
+    with chaos.scenario(seed=0):
+        chaos.on("checkpoint.async_write", nth=(1, 2))
+        mgr.save(1, {"blob": b"retry-me"})
+        mgr.wait_until_finished()           # no raise: 3rd attempt won
+    assert mgr.latest_step() == 1
+    assert telemetry.counter("checkpoint.write_retries").value == 2
+    assert telemetry.counter("checkpoint.write_failures").value == 0
+    assert chaos.stats()["survived"] == {"checkpoint.async_write": 1}
+
+
+def test_async_write_final_failure_surfaces(tmp_path, counters):
+    telemetry.reset("checkpoint.")
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr._writer = AsyncWriter(retries=1, backoff_s=0.01)
+    with chaos.scenario(seed=0):
+        chaos.on("checkpoint.async_write")  # every attempt dies
+        mgr.save(1, {"blob": b"doomed"})
+        with pytest.raises(chaos.ChaosInjected):
+            mgr.wait_until_finished()       # stored error re-raises
+    assert mgr.latest_step() is None
+    assert telemetry.counter("checkpoint.write_retries").value == 1
+    assert telemetry.counter("checkpoint.write_failures").value == 1
+    ev = telemetry.event("checkpoint.write_failed").recent[-1]
+    assert ev["attempts"] == 2
+
+
+# ---------------------------------------------------------------------
+# preemption: re-entrant signal delivery (satellite)
+# ---------------------------------------------------------------------
+
+def test_reentrant_sigterm_cannot_tear_the_save(tmp_path, counters):
+    telemetry.reset("preemption.")
+    from mxnet_tpu import preemption
+    net, trainer, _, _ = scenarios.train_fixtures(seed=0)
+    prefix = str(tmp_path / "job")
+    handler = preemption.PreemptionHandler(prefix, net, trainer,
+                                           signals=(),
+                                           save_in_handler=True)
+    nested = []
+
+    def deliver_nested(ctx):
+        # a second SIGTERM landing while the first handler (and its
+        # save) is still on this thread's stack
+        nested.append(True)
+        ctx["handler"]._on_signal(signal.SIGTERM, None)
+
+    with chaos.scenario(seed=0):
+        chaos.on("preemption.signal", nth=1, action=deliver_nested)
+        handler._on_signal(signal.SIGTERM, None)
+    assert nested and handler.saved
+    assert telemetry.counter("preemption.reentrant_signals").value == 1
+    assert chaos.stats()["survived"] == \
+        {"preemption.signal": 1}
+    # the checkpoint the ONE save wrote verifies and resumes
+    net2, trainer2, _, _ = scenarios.train_fixtures(seed=1)
+    meta = preemption.resume(prefix, net2, trainer2)
+    assert meta is not None
+    handler.uninstall()
+
+
+def test_signal_during_boundary_save_is_suppressed(tmp_path, counters):
+    """SIGTERM interrupting an in-progress save_now() (the boundary
+    save a `triggered` read started) must not start a second commit."""
+    telemetry.reset("preemption.")
+    from mxnet_tpu import preemption
+    net, trainer, _, _ = scenarios.train_fixtures(seed=0)
+    prefix = str(tmp_path / "job2")
+    handler = preemption.PreemptionHandler(prefix, net, trainer,
+                                           signals=())
+    orig = net.save_parameters
+    calls = []
+
+    def interrupted_save(path):
+        calls.append(path)
+        if len(calls) == 1:     # signal lands mid-commit, same thread
+            handler._on_signal(signal.SIGTERM, None)
+        return orig(path)
+
+    net.save_parameters = interrupted_save
+    handler.save_now(step=5)
+    assert len(calls) == 1      # ONE commit: no nested re-save ran
+    assert handler.saved and handler.triggered
+    assert telemetry.counter("preemption.reentrant_signals").value == 1
+    net2, trainer2, _, _ = scenarios.train_fixtures(seed=1)
+    meta = preemption.resume(prefix, net2, trainer2)
+    assert meta is not None and meta["step"] == 5
+    handler.uninstall()
+
+
+# ---------------------------------------------------------------------
+# batcher: flood past the queue bound (satellite)
+# ---------------------------------------------------------------------
+
+def test_flood_past_queue_bound_sheds_and_completes(counters):
+    telemetry.reset("serving.")
+    rep = scenarios.flood_scenario(seed=0, max_queue=4, clients=8,
+                                   per_client=8, hold_s=0.02)
+    # sheds happened, carried the DISTINCT error (anything else lands
+    # in rep["errors"]), and were counted
+    assert rep["shed"] > 0 and rep["errors"] == []
+    assert rep["shed_counter_delta"] == rep["shed"]
+    # every accepted request still completed -- in-flight work is
+    # never a casualty of backpressure
+    assert rep["completed"] + rep["shed"] == rep["requests"]
+    assert rep["completed"] > 0
+    # the bounded queue bounds the tail: worst wait is queue-depth
+    # stalls, not the flood's duration
+    assert rep["max_latency_s"] < rep["latency_bound_s"]
+
+
+def test_shed_error_is_distinct_and_inflight_completes():
+    net = scenarios.make_mlp()
+    reg = serving.ModelRegistry(compile_cache=False)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.dispatch", action=chaos.sleep(0.05), times=1)
+        s = reg.register("m", block=net, input_shape=(8,), buckets=(1,),
+                         max_wait_ms=1, max_queue=1)
+        x = np.ones(8, np.float32)
+        first = s.submit(x)                 # dispatched (stalled 50ms)
+        for _ in range(200):                # worker popped it?
+            if s.queue_depth() == 0:
+                break
+            time.sleep(0.002)  # mxlint: disable=sleep-poll
+        queued = s.submit(x)                # fills the queue
+        with pytest.raises(serving.ServingQueueFull):
+            s.submit(x)                     # the flood overflow
+        assert first.result(timeout=10) is not None
+        assert queued.result(timeout=10) is not None
+    reg.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------
+# the always-on loop: continuous train -> hot swap, under chaos
+# ---------------------------------------------------------------------
+
+def test_hotswap_zero_dropped_requests(tmp_path):
+    rep = scenarios.hotswap_scenario(str(tmp_path / "loop"), torn=False,
+                                     seed=0)
+    assert rep["first_swap_step"] == 2 and rep["second_swap_step"] == 4
+    assert rep["served_step"] == 4
+    # the acceptance gate: zero dropped (non-shed) requests across the
+    # swap, with traffic provably overlapping it
+    assert rep["errors"] == [] and rep["shed"] == 0
+    assert rep["completed"] == rep["requests"]
+    assert rep["completed_after_swap"] >= 1
+    assert rep["quarantined"] == []
+
+
+def test_kill_mid_commit_rolls_watcher_back(tmp_path):
+    rep = scenarios.hotswap_scenario(str(tmp_path / "loop"), torn=True,
+                                     seed=0)
+    # the torn publish is quarantined and the watcher keeps serving
+    # the previous verified step -- the rollback acceptance gate
+    assert rep["second_swap_step"] is None
+    assert rep["served_step"] == 2
+    assert rep["published_step"] == 4
+    assert rep["quarantined"] == ["step_00000004.corrupt"]
+    assert rep["errors"] == []
+    assert rep["chaos"]["injected"] == \
+        {"checkpoint.commit.post_commit": 1}
+    assert rep["chaos"]["survived"]["checkpoint.commit"] == 1
+
+
+def test_watcher_swap_serves_new_params(tmp_path, counters):
+    """After a swap the servable answers with the NEW step's weights."""
+    telemetry.reset("serving.")
+    net, ct = _loop_parts(tmp_path, publish_every=1)
+    reg = serving.ModelRegistry(compile_cache=False)
+    watcher = RegistryWatcher(reg, "m", ct.manager, scenarios.make_mlp(),
+                              input_shape=(8,), buckets=(1, 2),
+                              max_wait_ms=1, poll_s=0.05)
+    ct.run_steps(1)
+    assert watcher.poll_once() == 1
+    x = np.random.RandomState(3).rand(8).astype(np.float32)
+    want1 = net(mx.nd.array(x[None])).asnumpy()[0]
+    np.testing.assert_allclose(reg.infer("m", x, timeout=10), want1,
+                               rtol=1e-5, atol=1e-6)
+    ct.run_steps(1)                         # params moved; published
+    assert watcher.poll_once() == 2
+    want2 = net(mx.nd.array(x[None])).asnumpy()[0]
+    assert not np.allclose(want1, want2)    # training really moved them
+    np.testing.assert_allclose(reg.infer("m", x, timeout=10), want2,
+                               rtol=1e-5, atol=1e-6)
+    assert telemetry.counter("serving.swaps").value == 2
+    assert telemetry.gauge("serving.served_step").value == 2
+    ct.close()
+    watcher.close()
+    reg.shutdown(drain=True)
+
+
+def test_swap_abort_retries_with_backoff(tmp_path, counters):
+    telemetry.reset("serving.")
+    net, ct = _loop_parts(tmp_path, publish_every=1)
+    reg = serving.ModelRegistry(compile_cache=False)
+    watcher = RegistryWatcher(reg, "m", ct.manager, scenarios.make_mlp(),
+                              input_shape=(8,), buckets=(1,),
+                              max_wait_ms=1, swap_retries=1,
+                              swap_backoff_s=0.01)
+    ct.run_steps(1)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.swap", nth=1)     # first attempt aborts
+        assert watcher.poll_once() == 1     # retry lands it
+    assert watcher.served_step == 1
+    assert telemetry.counter("serving.swap_failures").value == 1
+    assert telemetry.counter("serving.swaps").value == 1
+    assert chaos.stats()["survived"]["serving.swap"] == 1
+    ct.close()
+    watcher.close()
+    reg.shutdown(drain=True)
+
+
+def test_swap_failure_budget_suspends_watcher(tmp_path, counters):
+    telemetry.reset("serving.")
+    net, ct = _loop_parts(tmp_path, publish_every=1)
+    reg = serving.ModelRegistry(compile_cache=False)
+    watcher = RegistryWatcher(reg, "m", ct.manager, scenarios.make_mlp(),
+                              input_shape=(8,), buckets=(1,),
+                              max_wait_ms=1, swap_retries=1,
+                              swap_backoff_s=0.01, failure_budget=2)
+    ct.run_steps(1)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.swap")            # every attempt aborts
+        with pytest.warns(RuntimeWarning, match="swap to step 1"):
+            assert watcher.poll_once() is None
+        assert watcher.bad_steps() == [1]   # skipped, not retried ad
+        assert watcher.poll_once() is None  # infinitum
+        assert not watcher.suspended        # budget is 2
+        ct.run_steps(1)                     # step 2 publishes
+        with pytest.warns(RuntimeWarning, match="budget exhausted"):
+            assert watcher.poll_once() is None
+        assert watcher.suspended
+    assert watcher.served_step is None
+    assert "m" not in reg                   # nothing half-installed
+    assert telemetry.counter("serving.swap_failures").value == 4
+    ct.close()
+    watcher.close()
+    reg.shutdown(drain=True)
+
+
+def test_continuous_trainer_resumes_from_published_step(tmp_path):
+    net, ct = _loop_parts(tmp_path, publish_every=2)
+    ct.run_steps(4)
+    assert ct.published_step == 4
+    ct.close()
+    # a fresh incarnation (crash restart) resumes at the published step
+    net2, trainer2, loss_fn2, data2 = scenarios.train_fixtures(seed=0)
+    ct2 = ContinuousTrainer(net2, trainer2, loss_fn2, data2,
+                            ct.manager.root, publish_every=2)
+    ckpt = ct2.resume()
+    assert ckpt is not None and ckpt.step == 4 and ct2.step == 4
+    ct2.run_steps(2)
+    assert ct2.published_step == 6
+    ct2.close()
+
+
+@pytest.mark.slow
+def test_soak_background_loop_many_swaps(tmp_path):
+    """Soak: trainer and watcher on their own threads, clients hammering
+    throughout; every published step must eventually serve and no
+    request may fail."""
+    net, ct = _loop_parts(tmp_path, publish_every=3)
+    reg = serving.ModelRegistry(compile_cache=False)
+    watcher = RegistryWatcher(reg, "m", ct.manager, scenarios.make_mlp(),
+                              input_shape=(8,), buckets=(1, 2, 4),
+                              max_wait_ms=2, poll_s=0.05)
+    errors = []
+    stop = threading.Event()
+    sample = np.random.RandomState(0).rand(8).astype(np.float32)
+
+    def client():
+        while not stop.is_set():
+            try:
+                reg.infer("m", sample, timeout=30)
+            except Exception as e:
+                errors.append(type(e).__name__)
+            time.sleep(0.002)  # mxlint: disable=sleep-poll
+
+    ct.run_steps(3)
+    assert watcher.poll_once() == 3
+    watcher.start()
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(3)]
+    for t in threads:
+        t.start()
+    ct.start(max_steps=12)                  # publishes steps 6..15
+    deadline = time.monotonic() + 60
+    while watcher.served_step != 15 and time.monotonic() < deadline:
+        time.sleep(0.05)  # mxlint: disable=sleep-poll
+    stop.set()
+    for t in threads:
+        t.join()
+    ct.close()
+    watcher.close()
+    reg.shutdown(drain=True)
+    assert watcher.served_step == 15
+    assert errors == []

@@ -321,6 +321,9 @@ def test_async_at_most_one_in_flight(tmp_path, write_gate):
 
 def test_async_error_reraised_at_next_save(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    # retries off: this test is about error SURFACING; a transient
+    # failure being rescued by the bounded retry is tests/test_chaos.py
+    mgr._writer._retries = 0
     orig = mgr._write_step
 
     def boom(*a, **k):
@@ -339,6 +342,7 @@ def test_async_error_reraised_at_next_save(tmp_path):
 
 def test_async_error_reraised_at_wait(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "ck"), async_save=True)
+    mgr._writer._retries = 0            # permanent failure, not weather
     mgr._write_step = lambda *a, **k: (_ for _ in ()).throw(
         OSError("enospc"))
     mgr.save(1, {"blob": b"x"})
