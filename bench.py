@@ -94,10 +94,21 @@ _ENV_DEGRADED = {"flag": None}     # None until the health probe ran
 
 def _mark_env_health(health):
     """Derive the degraded-environment flag from the env_health probe
-    (dispatch_roundtrip threshold); returns the flag for the line."""
+    (dispatch_roundtrip threshold); returns the flag for the line.
+    The probe numbers also land as telemetry gauges
+    (env.dispatch_roundtrip_us / env.h2d_mb_per_s) so the basis of a
+    degraded_env verdict survives in summarize output and the flight-
+    recorder dump, not just this process's stdout."""
     rtt = health.get("dispatch_roundtrip_us")
     _ENV_DEGRADED["flag"] = bool(rtt is not None
                                  and rtt > _DEGRADED_RTT_US)
+    try:
+        from mxnet_tpu import telemetry as _telemetry
+        if _telemetry._ENABLED and rtt is not None:
+            _telemetry.hooks.env_health(rtt,
+                                        health.get("h2d_mb_per_s"))
+    except Exception:
+        pass                  # health marking must never fail a bench
     return _ENV_DEGRADED["flag"]
 
 

@@ -73,6 +73,16 @@
 #              in interpret mode on CPU) whose perf audit must show
 #              zero drift against the blessed train_step:KernelSmokeNet
 #              row of ci/perf_baseline.json (mxlint --perf-diff)
+#   obs -> observability ops plane (docs/observability.md): a traced
+#          smoke train+serve run whose request spans must reconcile
+#          with the serving.requests/batches counters and whose
+#          dispatch+device_get span walls must equal the
+#          serving.dispatch_time timer; a chaos KILL mid-commit
+#          (seed 0) with the flight recorder installed -- the process
+#          dies 137 and the blackbox dump's final events must name the
+#          injected fault and the in-flight trace; and a /healthz flip
+#          gate -- READY while the watcher is good, NOT_READY after
+#          the swap failure budget suspends it
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -81,7 +91,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos obs bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -302,7 +312,8 @@ EOF
     JAX_PLATFORMS=cpu MXNET_TPU_TSAN=1 MXNET_TPU_TSAN_WATCHDOG_S=60 \
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py \
-        tests/test_serving.py tests/test_chaos.py -q -m 'not slow'
+        tests/test_serving.py tests/test_chaos.py tests/test_obs.py \
+        -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
     # paths (ISSUE 9) run with the lock sanitizer armed end to end
@@ -849,6 +860,158 @@ EOF
     python -m mxnet_tpu.analysis --perf-diff \
         ci/perf_baseline.json "$kdir/current.json" --json
     rm -rf "$kdir"
+}
+
+run_obs() {
+    log "obs: traced train+serve smoke -> span/counter reconciliation gate"
+    obsdir=$(mktemp -d /tmp/mxtpu_obs_ci.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 MXNET_TPU_OBS_TRACE=1 \
+        MXNET_TPU_TELEMETRY_JSONL="$obsdir/run.jsonl" python - <<'EOF'
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import obs, telemetry
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import ContinuousTrainer
+
+assert obs.tracing_enabled(), "MXNET_TPU_OBS_TRACE=1 did not arm tracing"
+assert mx.runtime.Features().is_enabled("OBS_TRACE")
+import tempfile
+net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+ct = ContinuousTrainer(net, trainer, loss_fn, data,
+                       tempfile.mkdtemp(), publish_every=2)
+ct.run_steps(4)                          # 4 traced steps, 2 publishes
+reg = mx.serving.ModelRegistry(compile_cache=False)
+reg.register("m", block=scenarios.make_mlp(), input_shape=(8,),
+             buckets=(1, 2, 4), max_wait_ms=5, max_queue=64)
+sample = np.random.RandomState(0).rand(8).astype(np.float32)
+for _ in range(10):
+    reg.infer("m", sample, timeout=30)
+reg.shutdown(drain=True); ct.close()
+telemetry.flush()
+print("traced smoke done:",
+      len(obs.spans()), "spans recorded")
+EOF
+    python - "$obsdir/run.jsonl" <<'EOF'
+import json, sys
+from mxnet_tpu.telemetry import cli as tcli
+agg = tcli.summarize_file(sys.argv[1])
+sp, c, t = agg["spans"], agg["counters"], agg["timers"]
+# causality <-> counters: one queue-wait + request span per accepted
+# request, one batch span per compiled dispatch
+assert sp["serving.queue_wait"]["count"] == c["serving.requests"], \
+    (sp.get("serving.queue_wait"), c.get("serving.requests"))
+assert sp["serving.request"]["count"] == c["serving.requests"]
+assert sp["serving.batch"]["count"] == c["serving.batches"]
+# span walls <-> timer telemetry: dispatch + device_get spans cover
+# EXACTLY the window the serving.dispatch_time timer observed
+span_wall = sp["serving.dispatch"]["sum"] + sp["serving.device_get"]["sum"]
+timer_wall = t["serving.dispatch_time"]["sum"]
+assert abs(span_wall - timer_wall) < 1e-4, (span_wall, timer_wall)
+# the training side of the causal tree
+assert sp["train.step"]["count"] == 4, sp.get("train.step")
+assert sp["train.publish"]["count"] == 2
+assert sp["checkpoint.commit"]["count"] == 2
+print("obs trace gate ok: %d request spans reconcile, dispatch wall "
+      "%.3fms == timer %.3fms" % (sp["serving.request"]["count"],
+                                  1e3 * span_wall, 1e3 * timer_wall))
+EOF
+    log "obs: chaos KILL mid-commit (seed 0) -> blackbox postmortem gate"
+    set +e
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 MXNET_TPU_OBS_TRACE=1 \
+        MXNET_TPU_OBS_BLACKBOX="$obsdir/crash.bbox" python - "$obsdir" <<'EOF'
+import sys
+from mxnet_tpu import chaos, obs
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import ContinuousTrainer
+
+assert obs.flight.installed() is not None, "blackbox did not install"
+net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+ct = ContinuousTrainer(net, trainer, loss_fn, data,
+                       sys.argv[1] + "/ckpts", publish_every=1)
+chaos.arm(seed=0)
+chaos.on("checkpoint.commit.pre_manifest", nth=2, action=chaos.KILL)
+ct.run_steps(2)                          # dies mid-commit of step 2
+raise SystemExit("chaos KILL did not fire")
+EOF
+    rc=$?
+    set -e
+    [ "$rc" -eq 137 ] || { echo "expected exit 137, got $rc"; exit 1; }
+    # the blackbox CLI must render it, and the machine gate must find
+    # the injected fault + the in-flight trace as the FINAL events
+    python -m mxnet_tpu.telemetry blackbox "$obsdir/crash.bbox"
+    python - "$obsdir/crash.bbox" <<'EOF'
+import sys
+from mxnet_tpu.obs import flight
+recs = flight.read(sys.argv[1])
+assert recs, "empty blackbox after a KILL"
+last = recs[-1]
+assert last.get("name") == "chaos.kill", last
+assert last["payload"]["point"] == "checkpoint.commit.pre_manifest"
+# the in-flight trace: the kill landed inside the traced
+# step->publish->commit chain, so the dump names the dying span
+assert last["payload"].get("trace") and last["payload"].get("span"), last
+names = [r.get("name") for r in recs]
+assert "chaos.inject" in names, "injected-fault event missing from ring"
+spans = [r for r in recs if r.get("kind") == "span"]
+assert any(s["name"] == "train.step" for s in spans), \
+    "no traced spans in the ring"
+print("obs blackbox gate ok: %d records, final=%s point=%s"
+      % (len(recs), last["name"], last["payload"]["point"]))
+EOF
+    log "obs: /healthz READY -> NOT_READY flip under the swap failure budget"
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 python - "$obsdir" <<'EOF'
+import json, sys, urllib.request, warnings
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, obs, telemetry
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import ContinuousTrainer, RegistryWatcher
+
+def get(port, path):
+    try:
+        r = urllib.request.urlopen("http://127.0.0.1:%d%s" % (port, path))
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+port = obs.serve(0)                      # ephemeral: CI-safe
+root = sys.argv[1] + "/health_ckpts"
+net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+ct = ContinuousTrainer(net, trainer, loss_fn, data, root, publish_every=1)
+ct.run_steps(1)
+reg = mx.serving.ModelRegistry(compile_cache=False)
+watcher = RegistryWatcher(reg, "m", ct.manager, scenarios.make_mlp(),
+                          input_shape=(8,), buckets=(1, 2),
+                          max_wait_ms=2, swap_retries=0,
+                          failure_budget=1)
+assert watcher.poll_once() == 1
+code, body = get(port, "/healthz")
+assert code == 200 and body["status"] == "READY", (code, body)
+prom = urllib.request.urlopen(
+    "http://127.0.0.1:%d/metrics" % port).read().decode()
+assert "mxnet_tpu_serving_swaps 1" in prom, prom[:400]
+code, st = get(port, "/statusz")
+assert st["served_step"] == 1 and st["watchers"][0]["name"] == "m", st
+# now every install aborts: publish a new step, let the watcher
+# exhaust its budget (retries=0, budget=1) and suspend
+ct.run_steps(1)
+chaos.arm(seed=0)
+chaos.on("serving.swap", action=chaos.RAISE)
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore", RuntimeWarning)
+    assert watcher.poll_once() is None
+chaos.disarm(); chaos.reset()
+assert watcher.suspended
+assert telemetry.counter("serving.watcher_suspensions").value == 1
+ev = telemetry.event("serving.watcher_suspended").recent[-1]
+assert ev["model"] == "m", ev
+code, body = get(port, "/healthz")
+assert code == 503 and body["status"] == "NOT_READY", (code, body)
+assert any(r.startswith("watcher_suspended:m") for r in body["reasons"])
+reg.shutdown(drain=True); watcher.close(); ct.close(); obs.server.stop()
+print("obs healthz gate ok: READY -> NOT_READY on suspension "
+      "(reasons=%s)" % body["reasons"])
+EOF
+    rm -rf "$obsdir"
 }
 
 run_bench() {

@@ -106,6 +106,7 @@ from . import base
 from .base import MXNetError
 from . import sync
 from . import telemetry
+from . import obs
 from . import chaos
 from .context import (Context, cpu, cpu_pinned, current_context, gpu,
                       num_gpus, num_tpus, tpu)

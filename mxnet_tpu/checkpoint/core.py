@@ -37,6 +37,7 @@ import warnings
 import zlib
 
 from .. import chaos as _chaos
+from .. import obs as _obs
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 
@@ -474,6 +475,15 @@ class CheckpointManager:
     def _write_step(self, step, snapshot, metadata):
         """Serialize a host snapshot into a staged dir and commit it.
         Runs on the writer thread under async saves."""
+        _sp = _obs.begin_span("checkpoint.commit", step=step) \
+            if _obs._TRACE_ENABLED else None
+        try:
+            return self._write_step_inner(step, snapshot, metadata)
+        finally:
+            if _sp is not None:
+                _obs.end_span(_sp)
+
+    def _write_step_inner(self, step, snapshot, metadata):
         final = self.step_dir(step)
         staging = "%s.%d.tmp" % (final, os.getpid())
         if os.path.isdir(staging):

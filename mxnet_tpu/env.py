@@ -302,6 +302,38 @@ _VARS = [
            "flapping -- the last good model keeps serving until an "
            "operator intervenes.  Per-watcher override: "
            "RegistryWatcher(failure_budget=...)."),
+    EnvVar("MXNET_TPU_OBS_TRACE", bool, False,
+           "'1' arms request/step tracing (mx.obs): context-propagated "
+           "trace/span IDs through the serving path (submit -> queue "
+           "wait -> batch assembly -> compiled dispatch -> device_get "
+           "-> respond, batcher fan-in as span links) and the training "
+           "loop (step -> publish -> checkpoint commit -> watcher "
+           "discover -> warm -> install), streamed into the telemetry "
+           "JSONL as span records and exportable as Chrome-trace JSON "
+           "(obs.export_chrome_trace).  Off (the default), every "
+           "traced site is a single module-flag check with zero trace "
+           "calls.  Runtime toggle: obs.enable_tracing()/"
+           "disable_tracing()."),
+    EnvVar("MXNET_TPU_OBS_BLACKBOX", str, "",
+           "Path of the crash-safe flight recorder (mx.obs.flight).  "
+           "When set, an mmap'd ring of the most recent telemetry "
+           "records/spans is installed at import and survives "
+           "os._exit/SIGKILL; it is marked+msync'd automatically from "
+           "the preemption handler, the chaos KILL path, and SIGUSR2 "
+           "(which also snapshots every thread's stack).  Render with "
+           "'mxtelemetry blackbox <path>'."),
+    EnvVar("MXNET_TPU_OBS_BLACKBOX_KB", int, 256,
+           "Flight-recorder ring capacity in KiB (the final-seconds "
+           "window an operator gets after a crash).  Per-recorder "
+           "override: obs.install_blackbox(capacity=...)."),
+    EnvVar("MXNET_TPU_OBS_PORT", int, 0,
+           "Port of the live-introspection HTTP server (mx.obs."
+           "server, localhost): /healthz (watcher failure budget + "
+           "async-writer failures + queue saturation -> READY/"
+           "NOT_READY), /metrics (Prometheus exposition of the live "
+           "registry), /statusz (served/published step, swap history, "
+           "bucket occupancy, per-rank heartbeats).  0 (default) = "
+           "not started; obs.serve(0) binds an ephemeral port."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -1,0 +1,86 @@
+"""Ops plane for the always-on loop (ISSUE 13): request/step tracing,
+a crash-safe flight recorder, and live HTTP introspection.
+
+``mx.telemetry`` (counters/histograms) says *how much*; ``mx.obs`` says
+*which one and why*:
+
+- **tracing** (``obs.trace``): context-propagated trace/span IDs
+  threaded through the serving path (submit -> queue wait -> batch
+  assembly -> compiled dispatch -> device_get -> respond, batcher
+  fan-in recorded as span links) and the training loop (step ->
+  publish -> checkpoint commit -> watcher discover -> warm -> install),
+  exported as Chrome-trace JSON and streamed into the telemetry JSONL;
+- **flight recorder** (``obs.flight``): a bounded mmap'd ring of the
+  last records that survives ``os._exit``/SIGKILL, dumped automatically
+  from the preemption handler, the chaos KILL path, and a SIGUSR2
+  stack-snapshot hook; render with ``mxtelemetry blackbox <file>``;
+- **introspection** (``obs.server``): ``/healthz`` (watcher failure
+  budget + writer errors + queue saturation), ``/metrics`` (Prometheus
+  exposition), ``/statusz`` (served/published step, swap history,
+  heartbeats) on ``MXNET_TPU_OBS_PORT``.
+
+Tracing is gated exactly like telemetry: disabled (the default), every
+instrumented site pays ONE module-flag check (``obs._TRACE_ENABLED``)
+and makes zero calls into ``obs.trace`` -- proven by
+tests/test_obs.py::test_tracing_disabled_makes_zero_trace_calls.
+Enable with ``MXNET_TPU_OBS_TRACE=1`` or ``obs.enable_tracing()``.
+"""
+from __future__ import annotations
+
+import os
+
+from . import flight, status, trace
+from .trace import (TraceContext, begin_span, current, end_span,
+                    export_chrome_trace, record_span, span, spans)
+from .trace import trace as start_trace
+
+__all__ = [
+    "enable_tracing", "disable_tracing", "tracing_enabled",
+    "start_trace", "span", "begin_span", "end_span", "record_span",
+    "current", "spans", "export_chrome_trace", "TraceContext",
+    "flight", "status", "server", "serve", "install_blackbox",
+]
+
+# THE flag every traced hot path checks (one module-attribute read).
+# Mutate only through enable_tracing()/disable_tracing().
+_TRACE_ENABLED = False
+
+
+def enable_tracing():
+    """Arm the trace hooks (idempotent)."""
+    global _TRACE_ENABLED
+    _TRACE_ENABLED = True
+
+
+def disable_tracing():
+    """Disarm the trace hooks; recorded spans are kept."""
+    global _TRACE_ENABLED
+    _TRACE_ENABLED = False
+
+
+def tracing_enabled():
+    return _TRACE_ENABLED
+
+
+def install_blackbox(path=None, capacity=None):
+    """Install the process flight recorder (see ``obs.flight``)."""
+    return flight.install(path, capacity=capacity)
+
+
+def serve(port=None):
+    """Start the introspection HTTP server (see ``obs.server``)."""
+    from . import server as _server
+    return _server.serve(port)
+
+
+from . import server  # noqa: E402  (handler imports status above)
+
+# env arming (same != "0" convention as telemetry)
+if os.environ.get("MXNET_TPU_OBS_TRACE", "0") != "0":
+    enable_tracing()
+_env_blackbox = os.environ.get("MXNET_TPU_OBS_BLACKBOX", "")
+if _env_blackbox:
+    flight.install(_env_blackbox)
+_env_port = os.environ.get("MXNET_TPU_OBS_PORT", "")
+if _env_port and _env_port != "0":
+    server.serve(int(_env_port))

@@ -1,0 +1,158 @@
+"""Process status board: what `/healthz` and `/statusz` read.
+
+Long-lived components register themselves (weakly -- the board never
+extends a lifetime): serving registries, registry watchers, continuous
+trainers.  The board derives **readiness** the way a load balancer or
+pod manager needs it:
+
+- a :class:`~mxnet_tpu.serving.loop.RegistryWatcher` that exhausted its
+  swap failure budget (suspended) means the process is serving a stale
+  model and flapping stopped -- NOT_READY until an operator intervenes;
+- a failed async checkpoint write (``checkpoint.write_failures``) means
+  published state is behind training -- NOT_READY;
+- a servable whose bounded queue sits at capacity is shedding load --
+  NOT_READY (scale out / back off).
+
+``/statusz`` adds the operator narrative: served vs published step,
+recent swap history (the ``serving.swap`` event ring), bucket
+occupancy, and per-rank last-heartbeat (the ContinuousTrainer loop
+beats once per step; a stale heartbeat is a wedged trainer even when
+every thread is technically alive).
+"""
+from __future__ import annotations
+
+import os
+import time
+import weakref
+
+__all__ = ["register_watcher", "register_registry", "register_trainer",
+           "heartbeat", "health", "statusz", "reset"]
+
+_watchers = weakref.WeakSet()
+_registries = weakref.WeakSet()
+_trainers = weakref.WeakSet()
+_heartbeats = {}                # rank -> wall time of last beat
+
+
+def _rank():
+    try:
+        return int(os.environ.get("MXNET_TPU_PROC_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def register_watcher(watcher):
+    _watchers.add(watcher)
+
+
+def register_registry(registry):
+    _registries.add(registry)
+
+
+def register_trainer(trainer):
+    _trainers.add(trainer)
+
+
+def heartbeat(rank=None):
+    """One liveness beat (the trainer loop calls this every step)."""
+    _heartbeats[_rank() if rank is None else int(rank)] = time.time()
+
+
+def reset():
+    """Drop every registration (tests)."""
+    _watchers.clear()
+    _registries.clear()
+    _trainers.clear()
+    _heartbeats.clear()
+
+
+def _counter_value(name):
+    from .. import telemetry as _telemetry
+    inst = _telemetry.registry().get(name)
+    return inst.value if inst is not None else 0
+
+
+def health():
+    """``(ready, reasons)``: ready is True iff reasons is empty."""
+    reasons = []
+    for w in list(_watchers):
+        try:
+            if w.suspended:
+                reasons.append("watcher_suspended:%s" % w.name)
+        except Exception:
+            continue
+    failures = _counter_value("checkpoint.write_failures")
+    if failures:
+        reasons.append("checkpoint_write_failures:%d" % failures)
+    for reg in list(_registries):
+        try:
+            names = reg.names()
+        except Exception:
+            continue
+        for name in names:
+            try:
+                s = reg.servable(name)
+                if s.queue_depth() >= s.queue_capacity:
+                    reasons.append("queue_saturated:%s" % name)
+            except Exception:
+                continue
+    return (not reasons), reasons
+
+
+def statusz():
+    """The full operator snapshot (JSON-ready)."""
+    from .. import telemetry as _telemetry
+    reg = _telemetry.registry()
+    watchers = []
+    for w in list(_watchers):
+        try:
+            watchers.append({"name": w.name,
+                             "served_step": w.served_step,
+                             "suspended": w.suspended,
+                             "bad_steps": w.bad_steps()})
+        except Exception:
+            continue
+    trainers = []
+    for t in list(_trainers):
+        try:
+            trainers.append({"step": t.step,
+                             "published_step": t.published_step})
+        except Exception:
+            continue
+    servables = []
+    for r in list(_registries):
+        try:
+            names = r.names()
+        except Exception:
+            continue
+        for name in names:
+            try:
+                s = r.servable(name)
+                servables.append({"name": name,
+                                  "queue_depth": s.queue_depth(),
+                                  "queue_capacity": s.queue_capacity,
+                                  "buckets": list(s.buckets)})
+            except Exception:
+                continue
+    swap_ev = reg.get("serving.swap")
+    occupancy = reg.get("serving.batch_occupancy")
+    served = reg.get("serving.served_step")
+    published = reg.get("train_loop.published_step")
+    ready, reasons = health()
+    return {
+        "pid": os.getpid(),
+        "rank": _rank(),
+        "time": time.time(),
+        "ready": ready,
+        "not_ready_reasons": reasons,
+        "served_step": served.value if served is not None else None,
+        "published_step": (published.value if published is not None
+                           else None),
+        "watchers": watchers,
+        "trainers": trainers,
+        "servables": servables,
+        "swap_history": swap_ev.recent if swap_ev is not None else [],
+        "bucket_occupancy": (occupancy.snapshot()
+                             if occupancy is not None else None),
+        "heartbeats": dict(_heartbeats),
+    }

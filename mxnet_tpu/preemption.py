@@ -29,6 +29,7 @@ import threading
 import warnings
 
 from . import chaos as _chaos
+from . import obs as _obs
 from . import sync as _sync
 from . import telemetry as _telemetry
 from .base import MXNetError
@@ -212,6 +213,13 @@ class PreemptionHandler:
         self._in_handler = True
         try:
             self._signal_seen = True
+            # black box: the preemption is exactly the death a flight
+            # recorder exists for -- mark it (with the in-flight trace)
+            # and msync so the final seconds survive the SIGKILL that
+            # follows the grace window
+            _obs.flight.emergency_dump("preemption.signal",
+                                       signum=signum,
+                                       prefix=self.prefix)
             # chaos: a rule here can deliver a nested signal (callable
             # action invoking _on_signal again) or stall the handler --
             # how tests prove the guard above holds

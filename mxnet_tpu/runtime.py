@@ -63,6 +63,9 @@ def _detect():
         # inside a chaos.arm()/chaos.scenario() window, never in a
         # production process (no env var arms it)
         "CHAOS": _chaos_armed(),
+        # request/step tracing (mx.obs): LIVE arm state, same contract
+        # as the TELEMETRY row
+        "OBS_TRACE": _obs_tracing(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -70,6 +73,11 @@ def _detect():
 def _telemetry_enabled():
     from . import telemetry
     return telemetry.enabled()
+
+
+def _obs_tracing():
+    from . import obs
+    return obs.tracing_enabled()
 
 
 def _tsan_enabled():

@@ -30,6 +30,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from .. import chaos as _chaos
+from .. import obs as _obs
 from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -52,13 +53,17 @@ class ServableClosed(MXNetError):
 
 
 class _Request:
-    __slots__ = ("x", "future", "t_submit", "deadline")
+    __slots__ = ("x", "future", "t_submit", "deadline", "tctx")
 
     def __init__(self, x, timeout):
         self.x = x
         self.future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = (self.t_submit + timeout) if timeout else None
+        # trace position of this request (obs tracing only): the
+        # submitter's context if it carries one, else a fresh trace --
+        # the worker thread records queue/respond spans against it
+        self.tctx = None
 
 
 # Worker idle poll: the condition is notified on submit/close, so this
@@ -103,6 +108,8 @@ class DynamicBatcher:
                 "carry ONE sample; the batcher builds the batch)"
                 % (x.shape, self._pool.input_shape))
         req = _Request(x, timeout)
+        if _obs._TRACE_ENABLED:
+            req.tctx = _obs.trace.fresh_context()
         shed = closed = False
         with self._cond:
             if self._closed:
@@ -163,6 +170,12 @@ class DynamicBatcher:
                 if r.deadline is not None and now > r.deadline:
                     if _telemetry._ENABLED:
                         _telemetry.hooks.serving_timeout(self._label)
+                    if _obs._TRACE_ENABLED and r.tctx is not None:
+                        _obs.record_span(
+                            "serving.request", r.tctx,
+                            t0=r.t_submit, dur=now - r.t_submit,
+                            attrs={"model": self._label,
+                                   "timeout": True})
                     r.future.set_exception(RequestTimeout(
                         "request waited %.1fms > timeout"
                         % (1e3 * (now - r.t_submit))))
@@ -187,21 +200,72 @@ class DynamicBatcher:
             _chaos.fail_point("serving.dispatch", model=self._label,
                               occupancy=n, bucket=bucket)
             outs = self._pool.call(bucket, batch)
+            t_call = time.perf_counter()
             outs = jax.device_get(outs)       # one gather for the batch
         except Exception as e:                # compiled call failed:
             for r in reqs:                    # fail the REQUESTS, keep
                 r.future.set_exception(e)     # the worker alive
             return
-        dt = time.perf_counter() - t0
-        done = time.perf_counter()
+        t_get = time.perf_counter()
+        dt = t_get - t0
         single = len(outs) == 1
         for i, r in enumerate(reqs):
             r.future.set_result(outs[0][i] if single
                                 else tuple(o[i] for o in outs))
+        done = time.perf_counter()
         if _telemetry._ENABLED:
             _telemetry.hooks.serving_batch(self._label, n, bucket, dt)
             for r in reqs:
                 _telemetry.hooks.serving_latency(done - r.t_submit)
+        if _obs._TRACE_ENABLED:
+            self._record_batch_spans(reqs, t0, t_call, t_get, done,
+                                     n, bucket)
+
+    def _record_batch_spans(self, reqs, t0, t_call, t_get, done, n,
+                            bucket):
+        """The serving causality record (obs tracing armed): each
+        request's trace gets queue-wait and respond child spans plus a
+        ``serving.request`` root; the batch itself is a fresh trace
+        whose root span LINKS every request span it served (Dapper
+        fan-in) with ``serving.batch_assembly`` / ``serving.dispatch``
+        / ``serving.device_get`` children.  ``serving.dispatch`` +
+        ``serving.device_get`` durations sum to exactly the window the
+        ``serving.dispatch_time`` timer observed -- the
+        span-vs-telemetry reconciliation CI's obs stage gates."""
+        tr = _obs.trace
+        model = self._label
+        links = []
+        for r in reqs:
+            ctx = r.tctx
+            if ctx is None:           # accepted before tracing armed
+                continue
+            links.append(ctx.span_id)
+            tr.record_span("serving.queue_wait", ctx.child(),
+                           parent_id=ctx.span_id, t0=r.t_submit,
+                           dur=t0 - r.t_submit,
+                           attrs={"model": model})
+            tr.record_span("serving.respond", ctx.child(),
+                           parent_id=ctx.span_id, t0=t_get,
+                           dur=done - t_get, attrs={"model": model})
+            tr.record_span("serving.request", ctx, t0=r.t_submit,
+                           dur=done - r.t_submit,
+                           attrs={"model": model, "bucket": bucket})
+        batch_ctx = tr.TraceContext(tr.new_id(), tr.new_id())
+        t_first = min(r.t_submit for r in reqs)
+        tr.record_span("serving.batch_assembly", batch_ctx.child(),
+                       parent_id=batch_ctx.span_id, t0=t_first,
+                       dur=t0 - t_first, attrs={"model": model})
+        tr.record_span("serving.dispatch", batch_ctx.child(),
+                       parent_id=batch_ctx.span_id, t0=t0,
+                       dur=t_call - t0,
+                       attrs={"model": model, "bucket": bucket})
+        tr.record_span("serving.device_get", batch_ctx.child(),
+                       parent_id=batch_ctx.span_id, t0=t_call,
+                       dur=t_get - t_call, attrs={"model": model})
+        tr.record_span("serving.batch", batch_ctx, t0=t0,
+                       dur=done - t0,
+                       attrs={"model": model, "occupancy": n,
+                              "bucket": bucket}, links=links)
 
     # -- lifecycle ------------------------------------------------------
     def queue_depth(self):

@@ -32,6 +32,7 @@ import threading
 import time
 import warnings
 
+from .. import obs as _obs
 from .. import sync as _sync
 from .. import telemetry as _telemetry
 from ..base import MXNetError
@@ -84,6 +85,7 @@ class ContinuousTrainer:
         self._step = 0
         self._published_step = None
         self._error = None
+        _obs.status.register_trainer(self)   # weak: /statusz heartbeat
 
     # -- state ----------------------------------------------------------
     @property
@@ -123,14 +125,24 @@ class ContinuousTrainer:
             with self._lock:
                 self._step += 1
                 step = self._step
-            x, y = self._data(step) if callable(self._data) else self._data
-            with autograd.record():
-                loss = self.loss_fn(self.block(x), y)
-            loss.backward()
-            self.trainer.step(x.shape[0])
-            last = loss
-            if step % self.publish_every == 0:
-                self.publish()
+            _sp = _obs.begin_span("train.step", step=step) \
+                if _obs._TRACE_ENABLED else None
+            try:
+                x, y = self._data(step) if callable(self._data) \
+                    else self._data
+                with autograd.record():
+                    loss = self.loss_fn(self.block(x), y)
+                loss.backward()
+                self.trainer.step(x.shape[0])
+                last = loss
+                if step % self.publish_every == 0:
+                    self.publish()
+            finally:
+                if _sp is not None:
+                    _obs.end_span(_sp)
+            # liveness beat for /statusz: a stale heartbeat means a
+            # wedged loop even when every thread is technically alive
+            _obs.status.heartbeat()
         return last
 
     def publish(self):
@@ -139,8 +151,14 @@ class ContinuousTrainer:
         with self._lock:
             step = self._step
         t0 = time.perf_counter()
-        self.manager.save_training(step, self.block, self.trainer,
-                                   metadata={"step": step})
+        _sp = _obs.begin_span("train.publish", step=step) \
+            if _obs._TRACE_ENABLED else None
+        try:
+            self.manager.save_training(step, self.block, self.trainer,
+                                       metadata={"step": step})
+        finally:
+            if _sp is not None:
+                _obs.end_span(_sp)
         with self._lock:
             self._published_step = step
         if _telemetry._ENABLED:
@@ -245,6 +263,7 @@ class RegistryWatcher:
         self._bad_steps = set()
         self._consecutive_failures = 0
         self._suspended = False
+        _obs.status.register_watcher(self)   # weak: /healthz readiness
 
     # -- state ----------------------------------------------------------
     @property
@@ -271,7 +290,15 @@ class RegistryWatcher:
         newer than what is serving.  Returns the newly served step, or
         None when nothing changed (no new step, step already bad, or
         the swap failed and the previous model keeps serving)."""
-        step = self.manager.latest_step()
+        _sp = _obs.begin_span("serving.watcher.discover",
+                              model=self.name) \
+            if _obs._TRACE_ENABLED else None
+        step = None
+        try:
+            step = self.manager.latest_step()
+        finally:
+            if _sp is not None:
+                _obs.end_span(_sp, step=step)
         if step is None:
             return None
         with self._lock:
@@ -283,6 +310,16 @@ class RegistryWatcher:
         return self._swap(step)
 
     def _swap(self, step):
+        _sp = _obs.begin_span("serving.swap", model=self.name,
+                              step=step) \
+            if _obs._TRACE_ENABLED else None
+        try:
+            return self._swap_attempts(step)
+        finally:
+            if _sp is not None:
+                _obs.end_span(_sp)
+
+    def _swap_attempts(self, step):
         from .. import chaos as _chaos
         t0 = time.perf_counter()
         attempts = self._swap_retries + 1
@@ -325,6 +362,12 @@ class RegistryWatcher:
                 self._suspended = True
             served = self._served_step
         _chaos.survived("serving.swap", "rollback")
+        if exhausted and _telemetry._ENABLED:
+            # terminal, alertable: the watcher stops flapping here and
+            # nothing will retry until an operator acts -- /healthz
+            # reports NOT_READY off the same state
+            _telemetry.hooks.serving_watcher_suspended(
+                self.name, step, self._failure_budget)
         warnings.warn(
             "serving watcher %r: swap to step %d failed after %d "
             "attempt(s) (%s); still serving step %r%s"

@@ -26,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import chaos as _chaos
+from .. import obs as _obs
 from .. import telemetry as _telemetry
 from ..base import MXNetError
 from .batcher import DynamicBatcher, ServableClosed
@@ -99,6 +100,12 @@ class Servable:
         return self._batcher.queue_depth()
 
     @property
+    def queue_capacity(self):
+        """Bounded queue depth past which submits shed (the
+        `/healthz` queue-saturation signal reads depth vs this)."""
+        return self._batcher.max_queue
+
+    @property
     def closed(self):
         return self._batcher.closed
 
@@ -126,6 +133,7 @@ class ModelRegistry:
         self._lock = _sync.Lock(name="serving.registry")
         self._servables = {}
         self._cache = CompileCache(cache_dir) if compile_cache else None
+        _obs.status.register_registry(self)   # weak: /healthz, /statusz
 
     # -- registration ---------------------------------------------------
     def register(self, name, block=None, symbol=None, params=None,
@@ -177,20 +185,33 @@ class ModelRegistry:
         pool = BucketExecutorPool(fn, pvals, input_shape, dtype, buckets,
                                   cache=self._cache, label=name)
         if warmup:
-            pool.warmup()
+            _w = _obs.begin_span("serving.register.warm", model=name) \
+                if _obs._TRACE_ENABLED else None
+            try:
+                pool.warmup()
+            finally:
+                if _w is not None:
+                    _obs.end_span(_w)
         # chaos: an abort here (after the expensive warm-up, before the
         # install) models every way a swap dies late; the previous
         # servable MUST keep serving untouched -- the watcher's
         # retry/backoff and failure budget hang off this contract
         _chaos.fail_point("serving.swap", model=name)
-        batcher = DynamicBatcher(pool, label=name, max_wait_ms=max_wait_ms,
-                                 max_queue=max_queue)
-        servable = Servable(name, pool, batcher, source)
-        with self._lock:
-            old = self._servables.get(name)
-            self._servables[name] = servable
-        if old is not None:
-            old.close(drain=True)
+        _i = _obs.begin_span("serving.register.install", model=name) \
+            if _obs._TRACE_ENABLED else None
+        try:
+            batcher = DynamicBatcher(pool, label=name,
+                                     max_wait_ms=max_wait_ms,
+                                     max_queue=max_queue)
+            servable = Servable(name, pool, batcher, source)
+            with self._lock:
+                old = self._servables.get(name)
+                self._servables[name] = servable
+            if old is not None:
+                old.close(drain=True)
+        finally:
+            if _i is not None:
+                _obs.end_span(_i)
         if _telemetry._ENABLED:
             _telemetry.hooks.serving_model(name, source, len(buckets))
         return servable

@@ -1,5 +1,15 @@
 """``python -m mxnet_tpu.telemetry`` -- offline analysis of telemetry
-JSONL run logs.
+JSONL run logs and flight-recorder black boxes.
+
+Subcommands:
+
+- ``summarize run.jsonl [more_rank_files...]`` -- aggregate one run log
+  (steps, compiles, kvstore, feed, serving, spans); given SEVERAL rank
+  files from one multi-host run, also emits per-rank step-time skew and
+  a straggler flag (max/median mean-step wall past ``--skew-threshold``)
+  -- the first skew instrument multi-host SPMD has.
+- ``blackbox crash.bbox`` -- render a flight-recorder ring
+  (``mx.obs.flight``): the final records before the process died.
 
 Contract mirrors the mxlint CLI (``mxnet_tpu.analysis.cli``): exit 0 on
 success with ``--json`` for machine-readable output, exit 1 when the log
@@ -12,9 +22,9 @@ import argparse
 import json
 import sys
 
-from .sinks import prom_text, summary_table
+from .sinks import _fmt_secs, prom_text, summary_table
 
-__all__ = ["main", "summarize_file"]
+__all__ = ["main", "summarize_file", "summarize_files"]
 
 # Exact-percentile bound: past this many streamed samples per timer the
 # tail is dropped from the percentile pool (count/sum/min/max stay
@@ -41,14 +51,29 @@ def _build_parser():
         description="Summarize a telemetry JSONL run log "
                     "(docs/observability.md).")
     sub = ap.add_subparsers(dest="cmd")
-    sm = sub.add_parser("summarize", help="aggregate a run.jsonl")
-    sm.add_argument("path", help="telemetry JSONL file "
-                                 "(MXNET_TPU_TELEMETRY_JSONL)")
+    sm = sub.add_parser("summarize", help="aggregate run.jsonl file(s)")
+    sm.add_argument("paths", nargs="+", metavar="path",
+                    help="telemetry JSONL file(s) "
+                         "(MXNET_TPU_TELEMETRY_JSONL); several files = "
+                         "per-rank skew analysis")
     sm.add_argument("--json", dest="as_json", action="store_true",
                     help="machine-readable aggregate")
     sm.add_argument("--prom", action="store_true",
                     help="Prometheus text exposition instead of the "
-                         "console table")
+                         "console table (single file only)")
+    sm.add_argument("--skew-threshold", type=float, default=1.25,
+                    help="straggler flag threshold on max/median "
+                         "mean-step wall across rank files "
+                         "(default 1.25)")
+    bb = sub.add_parser("blackbox",
+                        help="render a flight-recorder ring "
+                             "(mx.obs.flight / MXNET_TPU_OBS_BLACKBOX)")
+    bb.add_argument("path", help="flight-recorder file")
+    bb.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable record list")
+    bb.add_argument("--last", type=int, default=40,
+                    help="records to show in the human rendering "
+                         "(default 40)")
     return ap
 
 
@@ -64,7 +89,9 @@ def summarize_file(path):
     counters, gauges, timers, events = {}, {}, {}, {}
     sample_folds = {}
     event_folds = {}
+    span_folds = {}
     records = skipped = 0
+    rank = None
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -78,7 +105,20 @@ def summarize_file(path):
                 skipped += 1
                 continue
             records += 1
-            if kind == "sample":
+            if rank is None and isinstance(rec.get("rank"), int):
+                rank = rec["rank"]
+            if kind == "span":
+                agg = span_folds.setdefault(
+                    name, {"count": 0, "sum": 0.0, "min": None,
+                           "max": None})
+                d = float(rec.get("dur", 0.0))
+                agg["count"] += 1
+                agg["sum"] += d
+                agg["min"] = d if agg["min"] is None \
+                    else min(agg["min"], d)
+                agg["max"] = d if agg["max"] is None \
+                    else max(agg["max"], d)
+            elif kind == "sample":
                 agg = sample_folds.setdefault(
                     name, {"count": 0, "sum": 0.0, "min": None,
                            "max": None, "values": [], "t_first": None,
@@ -140,8 +180,13 @@ def summarize_file(path):
     compile_ev = events.get("compile", {})
     result = {
         "file": path,
+        "rank": rank,
         "records": records,
         "skipped": skipped,
+        "spans": {name: {**agg,
+                         "mean": (agg["sum"] / agg["count"])
+                         if agg["count"] else None}
+                  for name, agg in sorted(span_folds.items())},
         "counters": counters,
         "gauges": gauges,
         "timers": timers,
@@ -217,6 +262,109 @@ def _serving_section(counters, timers):
     }
 
 
+def summarize_files(paths, skew_threshold=1.25):
+    """Aggregate SEVERAL rank files from one multi-host run: per-rank
+    step statistics plus the skew verdict (straggler flag when the
+    slowest rank's mean step wall exceeds ``skew_threshold`` x the
+    median) -- GSPMD steps are lockstep, so a straggler rank drags
+    every rank's wall; this names it."""
+    per_rank = []
+    records = 0
+    for i, path in enumerate(paths):
+        agg = summarize_file(path)
+        records += agg["records"]
+        st = agg["steps"]
+        rank = agg["rank"] if agg["rank"] is not None else i
+        per_rank.append({
+            "file": path,
+            "rank": rank,
+            "records": agg["records"],
+            "steps": st["count"],
+            "mean_step_s": st["mean_s"],
+            "total_step_s": st["total_s"],
+            "samples_per_sec": st["samples_per_sec"],
+        })
+    means = sorted(r["mean_step_s"] for r in per_rank
+                   if r["mean_step_s"])
+    skew = None
+    stragglers = []
+    if means:
+        # lower-middle for even counts: with 2 ranks the healthy one is
+        # the reference, so a straggler pair reads as skewed, not 1.0
+        median = means[(len(means) - 1) // 2]
+        worst = means[-1]
+        skew = (worst / median) if median else None
+        if skew is not None:
+            stragglers = sorted(
+                r["rank"] for r in per_rank
+                if r["mean_step_s"]
+                and median
+                and r["mean_step_s"] / median > skew_threshold)
+    return {
+        "files": list(paths),
+        "records": records,
+        "ranks": per_rank,
+        "skew": {
+            "max_over_median": round(skew, 4) if skew else None,
+            "threshold": skew_threshold,
+            "straggler": bool(stragglers),
+            "straggler_ranks": stragglers,
+        },
+    }
+
+
+def _render_ranks(agg):
+    lines = ["telemetry rank summary: %d files (%d records)"
+             % (len(agg["files"]), agg["records"]), "",
+             "  %-6s %-8s %-12s %-12s %s"
+             % ("rank", "steps", "mean step", "total", "file"),
+             "  " + "-" * 68]
+    for r in agg["ranks"]:
+        lines.append("  %-6s %-8d %-12s %-12s %s"
+                     % (r["rank"], r["steps"],
+                        _fmt_secs(r["mean_step_s"]),
+                        _fmt_secs(r["total_step_s"]), r["file"]))
+    sk = agg["skew"]
+    if sk["max_over_median"] is not None:
+        lines.append("")
+        lines.append(
+            "  step-time skew max/median = %.3f (threshold %.2f): %s"
+            % (sk["max_over_median"], sk["threshold"],
+               "STRAGGLER rank(s) %s" % sk["straggler_ranks"]
+               if sk["straggler"] else "balanced"))
+    return "\n".join(lines)
+
+
+def _render_blackbox(records, path, last):
+    t_end = max((r.get("t") for r in records
+                 if isinstance(r.get("t"), (int, float))),
+                default=None)
+    shown = records[-last:] if last and last > 0 else records
+    lines = ["blackbox: %s (%d records, showing last %d)"
+             % (path, len(records), len(shown))]
+    for r in shown:
+        t = r.get("t")
+        rel = ("%+.3fs" % (t - t_end)) \
+            if t_end is not None and isinstance(t, (int, float)) \
+            else "?"
+        kind = r.get("kind", "?")
+        name = r.get("name", "?")
+        if kind == "span":
+            detail = "dur=%s trace=%s" % (_fmt_secs(r.get("dur")),
+                                          r.get("trace"))
+        elif kind == "event":
+            detail = json.dumps(r.get("payload"), default=str)[:120]
+        elif kind == "sample":
+            detail = "value=%s" % _fmt_secs(r.get("value"))
+        else:
+            detail = json.dumps({k: v for k, v in r.items()
+                                 if k not in ("kind", "name", "t")},
+                                default=str)[:120]
+        lines.append("  %-10s %-8s %-34s %s" % (rel, kind, name,
+                                                detail))
+    return "\n".join(lines)
+
+
 def _to_snapshot(agg):
     """Rebuild a Registry.snapshot()-shaped list from an aggregate so
     the offline CLI reuses the live renderers."""
@@ -282,28 +430,62 @@ def _render_human(agg):
                fd["producer_busy_s"] or 0.0, fd["consumer_wait_s"] or 0.0,
                ", overlap %.1f%%" % (100 * fd["overlap_frac"])
                if fd.get("overlap_frac") is not None else ""))
+    spn = agg.get("spans") or {}
+    if spn:
+        lines.append("  spans: %d recorded over %d names (top: %s)"
+                     % (sum(v["count"] for v in spn.values()), len(spn),
+                        ", ".join(sorted(
+                            spn, key=lambda n: -spn[n]["count"])[:4])))
     lines.append("")
     lines.append(summary_table(_to_snapshot(agg)))
     return "\n".join(lines)
 
 
-def main(argv=None) -> int:
-    ap = _build_parser()
-    args = ap.parse_args(argv)
-    if args.cmd != "summarize":
-        ap.print_usage()
-        return 2
+def _main_blackbox(args):
+    from ..obs import flight
+    from ..base import MXNetError
     try:
-        agg = summarize_file(args.path)
+        records = flight.read(args.path)
     except OSError as e:
         print("cannot read %s: %s" % (args.path, e), file=sys.stderr)
         return 1
+    except MXNetError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if not records:
+        print("no records in %s" % args.path, file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(records, indent=2, default=str))
+    else:
+        print(_render_blackbox(records, args.path, args.last))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = _build_parser()
+    args = ap.parse_args(argv)
+    if args.cmd == "blackbox":
+        return _main_blackbox(args)
+    if args.cmd != "summarize":
+        ap.print_usage()
+        return 2
+    multi = len(args.paths) > 1
+    try:
+        agg = summarize_files(args.paths, args.skew_threshold) \
+            if multi else summarize_file(args.paths[0])
+    except OSError as e:
+        print("cannot read: %s" % e, file=sys.stderr)
+        return 1
     if not agg["records"]:
-        print("no telemetry records in %s" % args.path, file=sys.stderr)
+        print("no telemetry records in %s" % " ".join(args.paths),
+              file=sys.stderr)
         return 1
     try:
         if args.as_json:
             print(json.dumps(agg, indent=2, sort_keys=True))
+        elif multi:
+            print(_render_ranks(agg))
         elif args.prom:
             print(prom_text(_to_snapshot(agg)), end="")
         else:

@@ -12,131 +12,13 @@ means the hot modules carry exactly one branch when telemetry is off --
 the zero-overhead contract tests/test_telemetry.py proves by counting
 calls into this module -- and the instrument naming stays in one place.
 
-Instrument naming (see docs/observability.md):
-
-=====================  ======  =========================================
-name                   kind    meaning
-=====================  ======  =========================================
-dispatch.op_calls      counter imperative op invocations (total)
-dispatch.op.<op>       counter per-op invocation count
-dispatch.host_sync     counter host sync points (asnumpy/wait/waitall)
-dispatch.host_sync.<k> counter per-kind sync count
-compile                event   one per XLA trace/compile, payload says
-                               where and why (cache-key diff on retrace)
-compile.count          counter total compiles
-compile.retraces       counter compiles that REPLACED warm cache state
-compile.build_time     timer   wall time spent tracing/compiling
-trainer.step_time      timer   Trainer.step wall time
-trainer.steps          counter optimizer steps taken
-trainer.samples        counter samples pushed through step()
-trainer.samples_per_sec gauge  throughput (Trainer.step + Speedometer)
-kvstore.push/pull/
-  pushpull             counter kvstore calls by verb
-kvstore.bytes          counter gradient bytes moved through kvstore
-kvstore.time           timer   wall time in pushpull (dispatch side)
-data.batches           counter batches produced by DataLoader
-data.wait_time         timer   consumer wait per batch (input
-                               starvation when this rivals step_time)
-feed.batches           counter batches staged by dataio.DeviceFeed
-feed.bytes_staged      counter bytes shipped host->device by the feed
-feed.producer_busy     timer   per-batch producer time (host batch +
-                               async device_put issue)
-feed.consumer_wait     timer   per-batch consumer wait on the staging
-                               queue (transfer not hidden when this
-                               rivals producer_busy)
-feed.overlap_frac      gauge   per-epoch share of producer time hidden
-                               behind compute: 1 - wait/busy
-amp.overflow           event   fp16 grad overflow (scale halved)
-amp.overflows          counter total overflow steps
-amp.rescale            event   loss-scale growth after a clean window
-amp.loss_scale         gauge   current loss scale
-checkpoint             event   checkpoint save/restore (preemption
-                               handler + CheckpointManager), payload
-                               carries step/bytes/duration
-checkpoint.saves       counter saves (incl. provisional)
-checkpoint.restores    counter restores (preemption resume + manager)
-checkpoint.bytes_written counter bytes committed by saves
-checkpoint.bytes_read  counter bytes loaded by restores
-checkpoint.save_time   timer   wall time serializing+committing a save
-checkpoint.restore_time timer  wall time verifying+loading a restore
-checkpoint.async_wait  timer   time a save spent draining the previous
-                               in-flight async write (rivals step time
-                               => saving faster than the I/O)
-sync.contention_wait   timer   time spent blocked acquiring a
-                               contended lock (MXNET_TPU_TSAN=1 only;
-                               labeled by lock role name)
-sync.hold_time         timer   lock hold duration (TSAN only)
-sync.watchdog_fires    counter deadlock-watchdog expiries (TSAN only)
-sync.inversions        counter lock-order inversions observed (TSAN
-                               report-only mode records instead of
-                               raising)
-profiling.reports      counter CostReports materialized by the
-                               mx.profiling store
-profiling.capture_time timer   wall time lowering/parsing one report
-profiling.capture      event   one per report, payload carries
-                               label + FLOPs
-profiling.step_time    timer   per-dispatch step wall recorded by
-                               TrainStep under MXNET_TPU_PROFILING=1
-                               (feeds the roofline)
-serving.requests       counter requests accepted by serving submit()
-serving.responses      counter responses scattered from dispatched
-                               batches (mean batch occupancy =
-                               responses / batches)
-serving.batches        counter compiled batch dispatches
-serving.batch_occupancy gauge  requests in the last dispatched batch
-                               (>1 = dynamic batching is working)
-serving.queue_depth    gauge   request-queue depth at last submit
-serving.shed           counter submits rejected by a full queue
-                               (ServingQueueFull backpressure)
-serving.timeouts       counter requests expired while queued
-                               (RequestTimeout)
-serving.latency        timer   per-request round trip submit ->
-                               response (the SLO metric; p50/p95/p99
-                               in the summarize CLI)
-serving.dispatch_time  timer   compiled-call wall per batch
-serving.warmup_time    timer   per-servable registration warm-up
-                               (all buckets compiled + executed)
-serving.models         counter servables registered
-serving.compile_cache_hits
-                       counter bucket executables served from the
-                               persistent serving compile cache
-serving.compile_cache_misses
-                       counter bucket executables compiled fresh (and
-                               committed to the cache)
-serving.compile_evictions
-                       counter Predictor per-shape jit programs
-                               evicted by the LRU bound
-serving.swaps          counter successful hot-swaps (RegistryWatcher
-                               re-register to a newer verified step)
-serving.swap_failures  counter swap attempts that aborted (previous
-                               servable kept serving)
-serving.swap_time      timer   wall per successful swap (restore +
-                               warm-up + install + old-servable drain)
-serving.served_step    gauge   checkpoint step the live servable was
-                               loaded from
-train_loop.publishes   counter checkpoints published by
-                               ContinuousTrainer
-train_loop.published_step
-                       gauge   newest step the trainer published
-checkpoint.quarantined counter verification-failed steps renamed to
-                               step_<N>.corrupt during discovery (each
-                               is a rollback an operator should see)
-checkpoint.write_retries
-                       counter async-writer attempts retried after a
-                               transient failure (exp backoff)
-checkpoint.write_failures
-                       counter async writes that failed EVERY attempt
-                               (error also re-raises at next save/wait)
-preemption.reentrant_signals
-                       counter re-entrant SIGTERM deliveries suppressed
-                               while a save was mid-commit
-chaos.injected         counter faults injected by armed fail points
-                               (chaos.injected.<point> per point)
-chaos.survived         counter faults tolerated by a recovery path --
-                               quarantine, write retry, swap rollback,
-                               re-entrant-signal suppression
-                               (chaos.survived.<point> per point)
-=====================  ======  =========================================
+The instrument catalogue is DATA, not prose: :data:`INSTRUMENTS` below
+is the single source of truth, and the index table in
+``docs/observability.md`` is generated from it
+(:func:`update_observability_doc`, the same cannot-go-stale contract as
+``docs/env_vars.md``).  tests/test_obs.py cross-checks every literal
+instrument name used in this module against the catalogue, so adding a
+hook without cataloguing it fails CI.
 """
 from __future__ import annotations
 
@@ -153,6 +35,7 @@ __all__ = [
     "serving_swap", "train_publish", "checkpoint_quarantine",
     "checkpoint_retry", "checkpoint_write_failed",
     "preemption_reentry", "chaos_inject", "chaos_survive",
+    "serving_watcher_suspended", "env_health",
 ]
 
 
@@ -441,3 +324,291 @@ def chaos_survive(point, how):
     reg.counter("chaos.survived").inc()
     reg.counter("chaos.survived." + point).inc()
     reg.event("chaos.survive").emit(point=point, how=how)
+
+
+def serving_watcher_suspended(model, step, budget):
+    """A RegistryWatcher exhausted its swap failure budget and went
+    terminal -- it will never retry on its own, so this is the event an
+    operator alert must hang off (and /healthz reads NOT_READY)."""
+    reg = _registry()
+    reg.counter("serving.watcher_suspensions").inc()
+    reg.event("serving.watcher_suspended").emit(model=model, step=step,
+                                                budget=budget)
+
+
+def env_health(dispatch_roundtrip_us, h2d_mb_per_s=None):
+    """The bench environment-health probe's numbers, recorded so the
+    basis of a `degraded_env` verdict appears in summarize and in the
+    flight-recorder dump instead of dying with the bench stdout."""
+    reg = _registry()
+    reg.gauge("env.dispatch_roundtrip_us").set(dispatch_roundtrip_us)
+    if h2d_mb_per_s is not None:
+        reg.gauge("env.h2d_mb_per_s").set(h2d_mb_per_s)
+    reg.event("env.health").emit(
+        dispatch_roundtrip_us=dispatch_roundtrip_us,
+        h2d_mb_per_s=h2d_mb_per_s)
+
+
+# ----------------------------------------------------------------------
+# the instrument catalogue -- data the docs are generated from
+# ----------------------------------------------------------------------
+
+class InstrumentInfo:
+    """One catalogued instrument: (name, kind, subsystem, since-PR,
+    meaning).  ``name`` may carry a ``<placeholder>`` segment for
+    per-key instrument families (``dispatch.op.<op>``)."""
+
+    __slots__ = ("name", "kind", "subsystem", "since", "doc")
+
+    def __init__(self, name, kind, subsystem, since, doc):
+        self.name = name
+        self.kind = kind
+        self.subsystem = subsystem
+        self.since = since
+        self.doc = doc
+
+
+def _ii(name, kind, subsystem, since, doc):
+    return InstrumentInfo(name, kind, subsystem, since, doc)
+
+
+INSTRUMENTS = [
+    _ii("dispatch.op_calls", "counter", "ndarray", 2,
+        "imperative op invocations (total)"),
+    _ii("dispatch.op.<op>", "counter", "ndarray", 2,
+        "per-op invocation count"),
+    _ii("dispatch.host_sync", "counter", "ndarray", 2,
+        "host sync points (asnumpy/wait/waitall)"),
+    _ii("dispatch.host_sync.<kind>", "counter", "ndarray", 2,
+        "per-kind sync count"),
+    _ii("compile", "event", "compile", 2,
+        "one per XLA trace/compile; payload says where and why "
+        "(cache-key diff on retrace)"),
+    _ii("compile.count", "counter", "compile", 2, "total compiles"),
+    _ii("compile.retraces", "counter", "compile", 2,
+        "compiles that REPLACED warm cache state"),
+    _ii("compile.build_time", "timer", "compile", 2,
+        "wall time spent tracing/compiling"),
+    _ii("trainer.step_time", "timer", "trainer", 2,
+        "Trainer.step wall time"),
+    _ii("trainer.steps", "counter", "trainer", 2,
+        "optimizer steps taken"),
+    _ii("trainer.samples", "counter", "trainer", 2,
+        "samples pushed through step()"),
+    _ii("trainer.samples_per_sec", "gauge", "trainer", 2,
+        "throughput (Trainer.step + Speedometer)"),
+    _ii("kvstore.push", "counter", "kvstore", 2,
+        "kvstore push calls"),
+    _ii("kvstore.pull", "counter", "kvstore", 2,
+        "kvstore pull calls"),
+    _ii("kvstore.pushpull", "counter", "kvstore", 2,
+        "kvstore fused pushpull calls"),
+    _ii("kvstore.bytes", "counter", "kvstore", 2,
+        "gradient bytes moved through kvstore (ZERO on the SPMD hot "
+        "path -- gradients reduce in-graph)"),
+    _ii("kvstore.time", "timer", "kvstore", 2,
+        "wall time in pushpull (dispatch side)"),
+    _ii("dist.collectives", "counter", "distributed", 9,
+        "host-side cross-process collectives issued"),
+    _ii("dist.<kind>", "counter", "distributed", 9,
+        "per-kind collective count (allreduce/broadcast/...)"),
+    _ii("dist.bytes", "counter", "distributed", 9,
+        "bytes moved by host collectives"),
+    _ii("dist.tensors_coalesced", "counter", "distributed", 9,
+        "tensors folded into bucketed collectives (vs dist.collectives "
+        "= the coalescing win)"),
+    _ii("data.batches", "counter", "dataio", 2,
+        "batches produced by DataLoader"),
+    _ii("data.wait_time", "timer", "dataio", 2,
+        "consumer wait per batch (input starvation when this rivals "
+        "step_time)"),
+    _ii("feed.batches", "counter", "dataio", 4,
+        "batches staged by dataio.DeviceFeed"),
+    _ii("feed.bytes_staged", "counter", "dataio", 4,
+        "bytes shipped host->device by the feed"),
+    _ii("feed.producer_busy", "timer", "dataio", 4,
+        "per-batch producer time (host batch + async device_put "
+        "issue)"),
+    _ii("feed.consumer_wait", "timer", "dataio", 4,
+        "per-batch consumer wait on the staging queue"),
+    _ii("feed.overlap_frac", "gauge", "dataio", 4,
+        "share of producer time hidden behind compute: 1 - wait/busy"),
+    _ii("amp.overflow", "event", "amp", 2,
+        "fp16 grad overflow (scale halved)"),
+    _ii("amp.overflows", "counter", "amp", 2, "total overflow steps"),
+    _ii("amp.rescale", "event", "amp", 2,
+        "loss-scale growth after a clean window"),
+    _ii("amp.loss_scale", "gauge", "amp", 2, "current loss scale"),
+    _ii("checkpoint", "event", "checkpoint", 2,
+        "checkpoint save/restore; payload carries step/bytes/duration"),
+    _ii("checkpoint.saves", "counter", "checkpoint", 3,
+        "saves (incl. provisional)"),
+    _ii("checkpoint.restores", "counter", "checkpoint", 3,
+        "restores (preemption resume + manager)"),
+    _ii("checkpoint.bytes_written", "counter", "checkpoint", 3,
+        "bytes committed by saves"),
+    _ii("checkpoint.bytes_read", "counter", "checkpoint", 3,
+        "bytes loaded by restores"),
+    _ii("checkpoint.save_time", "timer", "checkpoint", 3,
+        "wall time serializing+committing a save"),
+    _ii("checkpoint.restore_time", "timer", "checkpoint", 3,
+        "wall time verifying+loading a restore"),
+    _ii("checkpoint.async_wait", "timer", "checkpoint", 3,
+        "time a save spent draining the previous in-flight async "
+        "write"),
+    _ii("checkpoint.quarantined", "counter", "checkpoint", 12,
+        "verification-failed steps renamed step_<N>.corrupt during "
+        "discovery"),
+    _ii("checkpoint.write_retries", "counter", "checkpoint", 12,
+        "async-writer attempts retried after a transient failure"),
+    _ii("checkpoint.write_retry", "event", "checkpoint", 12,
+        "one async-writer retry; payload carries attempt + error"),
+    _ii("checkpoint.write_failures", "counter", "checkpoint", 12,
+        "async writes that failed EVERY attempt (also re-raises at "
+        "next save/wait; flips /healthz NOT_READY)"),
+    _ii("checkpoint.write_failed", "event", "checkpoint", 12,
+        "terminal async write failure; payload carries attempts + "
+        "error"),
+    _ii("checkpoint.quarantine", "event", "checkpoint", 12,
+        "one quarantine rename; payload carries step + path"),
+    _ii("sync.contention_wait", "timer", "sync", 5,
+        "time blocked acquiring a contended lock (TSAN only; labeled "
+        "by lock role)"),
+    _ii("sync.hold_time", "timer", "sync", 5,
+        "lock hold duration (TSAN only)"),
+    _ii("sync.watchdog_fires", "counter", "sync", 5,
+        "deadlock-watchdog expiries (TSAN only)"),
+    _ii("sync.watchdog", "event", "sync", 5,
+        "one watchdog expiry; payload names the lock"),
+    _ii("sync.inversions", "counter", "sync", 5,
+        "lock-order inversions observed (report-only mode)"),
+    _ii("sync.inversion", "event", "sync", 5,
+        "one inversion; payload carries outer/inner roles"),
+    _ii("profiling.reports", "counter", "profiling", 6,
+        "CostReports materialized by the mx.profiling store"),
+    _ii("profiling.capture_time", "timer", "profiling", 6,
+        "wall time lowering/parsing one report"),
+    _ii("profiling.capture", "event", "profiling", 6,
+        "one per report; payload carries label + FLOPs"),
+    _ii("profiling.step_time", "timer", "profiling", 6,
+        "per-dispatch step wall recorded by TrainStep (feeds the "
+        "roofline)"),
+    _ii("serving.requests", "counter", "serving", 8,
+        "requests accepted by serving submit()"),
+    _ii("serving.responses", "counter", "serving", 8,
+        "responses scattered from dispatched batches"),
+    _ii("serving.batches", "counter", "serving", 8,
+        "compiled batch dispatches (mean occupancy = responses / "
+        "batches)"),
+    _ii("serving.batch_occupancy", "gauge", "serving", 8,
+        "requests in the last dispatched batch (>1 = dynamic batching "
+        "works)"),
+    _ii("serving.queue_depth", "gauge", "serving", 8,
+        "request-queue depth at last submit"),
+    _ii("serving.shed", "counter", "serving", 8,
+        "submits rejected by a full queue (ServingQueueFull)"),
+    _ii("serving.timeouts", "counter", "serving", 8,
+        "requests expired while queued (RequestTimeout)"),
+    _ii("serving.latency", "timer", "serving", 8,
+        "per-request round trip submit -> response (the SLO metric)"),
+    _ii("serving.dispatch_time", "timer", "serving", 8,
+        "compiled-call + device_get wall per batch (reconciles with "
+        "the serving.dispatch + serving.device_get trace spans)"),
+    _ii("serving.warmup_time", "timer", "serving", 8,
+        "per-servable registration warm-up"),
+    _ii("serving.models", "counter", "serving", 8,
+        "servables registered"),
+    _ii("serving.register", "event", "serving", 8,
+        "one servable registration; payload carries source + buckets"),
+    _ii("serving.compile_cache_hits", "counter", "serving", 8,
+        "bucket executables served from the persistent compile cache"),
+    _ii("serving.compile_cache_misses", "counter", "serving", 8,
+        "bucket executables compiled fresh"),
+    _ii("serving.compile_evictions", "counter", "serving", 8,
+        "Predictor per-shape jit programs evicted by the LRU bound"),
+    _ii("serving.swaps", "counter", "serving", 12,
+        "successful hot-swaps to a newer verified step"),
+    _ii("serving.swap_failures", "counter", "serving", 12,
+        "swap attempts that aborted (previous servable kept serving)"),
+    _ii("serving.swap_time", "timer", "serving", 12,
+        "wall per successful swap (restore + warm + install + drain)"),
+    _ii("serving.swap", "event", "serving", 12,
+        "one swap attempt; payload carries step/ok/attempt/error "
+        "(the /statusz swap history)"),
+    _ii("serving.served_step", "gauge", "serving", 12,
+        "checkpoint step the live servable was loaded from"),
+    _ii("serving.watcher_suspensions", "counter", "serving", 13,
+        "watchers that exhausted the swap failure budget and went "
+        "terminal"),
+    _ii("serving.watcher_suspended", "event", "serving", 13,
+        "the terminal suspension; payload names model/step/budget -- "
+        "alert on this, /healthz reads NOT_READY off the same state"),
+    _ii("train_loop.publishes", "counter", "serving", 12,
+        "checkpoints published by ContinuousTrainer"),
+    _ii("train_loop.published_step", "gauge", "serving", 12,
+        "newest step the trainer published"),
+    _ii("train_loop.publish", "event", "serving", 12,
+        "one publish; payload carries step + seconds"),
+    _ii("preemption.reentrant_signals", "counter", "preemption", 12,
+        "re-entrant SIGTERM deliveries suppressed mid-commit"),
+    _ii("chaos.injected", "counter", "chaos", 12,
+        "faults injected by armed fail points"),
+    _ii("chaos.injected.<point>", "counter", "chaos", 12,
+        "per-point injected count"),
+    _ii("chaos.inject", "event", "chaos", 12,
+        "one injection; payload carries point + action"),
+    _ii("chaos.survived", "counter", "chaos", 12,
+        "faults tolerated by a recovery path (injected or real)"),
+    _ii("chaos.survived.<point>", "counter", "chaos", 12,
+        "per-point survived count"),
+    _ii("chaos.survive", "event", "chaos", 12,
+        "one tolerated fault; payload carries point + how"),
+    _ii("env.dispatch_roundtrip_us", "gauge", "bench", 13,
+        "bench env-health dispatch round trip (the degraded_env "
+        "basis)"),
+    _ii("env.h2d_mb_per_s", "gauge", "bench", 13,
+        "bench env-health host->device bandwidth"),
+    _ii("env.health", "event", "bench", 13,
+        "one env-health probe; payload carries both numbers"),
+]
+
+_INDEX_BEGIN = "<!-- instrument-index:begin (generated; do not edit" \
+    " -- python -c 'from mxnet_tpu.telemetry import hooks; " \
+    "hooks.update_observability_doc()') -->"
+_INDEX_END = "<!-- instrument-index:end -->"
+
+
+def instrument_index_md():
+    """The generated markdown instrument index (without markers)."""
+    lines = ["| Instrument | Kind | Subsystem | Since | Meaning |",
+             "|---|---|---|---|---|"]
+    for ii in INSTRUMENTS:
+        lines.append("| `%s` | %s | %s | PR %d | %s |"
+                     % (ii.name, ii.kind, ii.subsystem, ii.since,
+                        ii.doc))
+    return "\n".join(lines) + "\n"
+
+
+def update_observability_doc(path=None):
+    """Regenerate the instrument index between the markers in
+    ``docs/observability.md`` (the docs/env_vars.md contract: the table
+    is generated from the registry the hooks actually use, so it cannot
+    drift).  Returns the new file text."""
+    import os
+    if path is None:
+        path = os.path.join(os.path.dirname(__file__), "..", "..",
+                            "docs", "observability.md")
+    with open(path) as f:
+        text = f.read()
+    try:
+        head, rest = text.split(_INDEX_BEGIN, 1)
+        _old, tail = rest.split(_INDEX_END, 1)
+    except ValueError:
+        raise RuntimeError(
+            "observability doc %s is missing the instrument-index "
+            "markers" % path)
+    new = (head + _INDEX_BEGIN + "\n" + instrument_index_md()
+           + _INDEX_END + tail)
+    with open(path, "w") as f:
+        f.write(new)
+    return new

@@ -14,6 +14,7 @@ CLI can re-render a JSONL file through either text format offline.
 from __future__ import annotations
 
 import json
+import os
 import re
 
 from .. import sync as _sync
@@ -21,20 +22,36 @@ from .. import sync as _sync
 __all__ = ["JsonlSink", "prom_text", "summary_table"]
 
 
+def _default_rank():
+    """This process's rank per the launcher env (0 single-process) --
+    every JSONL record is tagged with it so multi-host runs can be
+    merged and skew-analyzed offline (``mxtelemetry summarize r0.jsonl
+    r1.jsonl ...``)."""
+    try:
+        return int(os.environ.get("MXNET_TPU_PROC_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
 class JsonlSink:
     """Append telemetry records to ``path`` as one JSON object per line.
 
     Writes are line-buffered under a lock (instrument hooks may fire
     from DataLoader worker threads); ``flush()`` fsyncs nothing -- a
-    telemetry log is advisory, not a WAL.
+    telemetry log is advisory, not a WAL.  Every record carries this
+    process's ``rank`` (``MXNET_TPU_PROC_ID``), so rank files from one
+    multi-host run stay attributable after a merge.
     """
 
-    def __init__(self, path):
+    def __init__(self, path, rank=None):
         self.path = path
+        self.rank = _default_rank() if rank is None else int(rank)
         self._lock = _sync.Lock(name="telemetry.jsonl_sink")
         self._f = open(path, "a")
 
     def write(self, record):
+        if "rank" not in record:
+            record = dict(record, rank=self.rank)
         line = json.dumps(record, default=_json_default)
         with self._lock:
             if self._f is not None:

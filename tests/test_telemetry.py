@@ -524,6 +524,60 @@ def test_instrument_increments_atomic_under_hammer():
     assert e.count == N * M
 
 
+def test_concurrent_sink_flush_no_torn_lines(tmp_path):
+    """ISSUE 13 satellite (extends the PR-5 hammer): 8 threads hammer
+    events + timer samples through an attached JSONL sink while the
+    main thread flushes repeatedly and renders the Prometheus
+    exposition -- every line in the file must parse (no torn/interleaved
+    writes) and the streamed counts must be exact."""
+    import threading
+
+    from mxnet_tpu.telemetry import Registry
+    from mxnet_tpu.telemetry.sinks import JsonlSink, prom_text
+
+    path = str(tmp_path / "hammer.jsonl")
+    reg = Registry()
+    sink = reg.attach(JsonlSink(path))
+    e = reg.event("hammer.event")
+    t = reg.timer("hammer.time")
+    N, M = 8, 400
+    barrier = threading.Barrier(N + 1)
+
+    def pound(tid):
+        barrier.wait()
+        for i in range(M):
+            e.emit(tid=tid, i=i)
+            t.observe(1e-6)
+
+    threads = [threading.Thread(target=pound, args=(k,), daemon=True)
+               for k in range(N)]
+    for th in threads:
+        th.start()
+    barrier.wait()
+    for _ in range(50):                   # flush + render MID-hammer
+        reg.flush()
+        prom_text(reg.snapshot())
+    for th in threads:
+        th.join(timeout=60)
+    reg.flush()
+    sink.close()
+    # writes after close are dropped silently, never raise
+    e.emit(tid=-1, i=-1)
+
+    events = samples = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            rec = json.loads(line)        # a torn line would raise here
+            if rec["kind"] == "event" and rec["name"] == "hammer.event":
+                events += 1
+            elif rec["kind"] == "sample" and rec["name"] == "hammer.time":
+                samples += 1
+    assert events == N * M, events        # exact: nothing lost or torn
+    assert samples == N * M, samples
+    assert e.count == N * M + 1           # the post-close emit counted
+    assert t.count == N * M
+
+
 def test_registry_get_or_create_race_returns_one_instance():
     import threading
 
