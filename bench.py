@@ -95,13 +95,20 @@ _ENV_DEGRADED = {"flag": None}     # None until the health probe ran
 def _mark_env_health(health):
     """Derive the degraded-environment flag from the env_health probe
     (dispatch_roundtrip threshold); returns the flag for the line.
-    The probe numbers also land as telemetry gauges
-    (env.dispatch_roundtrip_us / env.h2d_mb_per_s) so the basis of a
-    degraded_env verdict survives in summarize output and the flight-
-    recorder dump, not just this process's stdout."""
+    The threshold is THE goodput sentinel's env guard
+    (obs.goodput.env_degraded / DEGRADED_RTT_US), so the per-line flag
+    and a goodput.env_degraded event can never disagree
+    (test_bench_contract).  The probe numbers also land as telemetry
+    gauges (env.dispatch_roundtrip_us / env.h2d_mb_per_s) so the basis
+    of a degraded_env verdict survives in summarize output and the
+    flight-recorder dump, not just this process's stdout."""
     rtt = health.get("dispatch_roundtrip_us")
-    _ENV_DEGRADED["flag"] = bool(rtt is not None
-                                 and rtt > _DEGRADED_RTT_US)
+    try:
+        from mxnet_tpu.obs import goodput as _goodput
+        flag = _goodput.env_degraded(rtt) if rtt is not None else False
+    except Exception:
+        flag = bool(rtt is not None and rtt > _DEGRADED_RTT_US)
+    _ENV_DEGRADED["flag"] = flag
     try:
         from mxnet_tpu import telemetry as _telemetry
         if _telemetry._ENABLED and rtt is not None:
@@ -110,6 +117,63 @@ def _mark_env_health(health):
     except Exception:
         pass                  # health marking must never fail a bench
     return _ENV_DEGRADED["flag"]
+
+
+# ----------------------------------------------------------------------
+# goodput breakdowns (ISSUE 14): the scan/LARS/e2e lines carry the
+# StepLedger's per-category wall attribution + bottleneck verdict, so
+# the synthetic-vs-e2e gap is auto-attributed in the artifact itself.
+# ----------------------------------------------------------------------
+
+_GOODPUT = {}                 # tag -> compact goodput line summary
+
+
+def _goodput_begin():
+    """Open a StepLedger over a measured window (arming telemetry +
+    profiling if off, so the category instruments record); returns
+    ``(ledger, restore_fn)``, or ``(None, noop)`` when obs is
+    unavailable -- a failed ledger costs the breakdown, never the
+    benchmark."""
+    try:
+        from mxnet_tpu import profiling, telemetry
+        from mxnet_tpu.obs import goodput as _gp
+        was_t = telemetry.enabled()
+        was_p = profiling.enabled()
+        telemetry.enable()
+        profiling.enable()
+        ledger = _gp.StepLedger(window_steps=1 << 30)  # manual flush
+
+        def restore():
+            if not was_t:
+                telemetry.disable()
+            if not was_p:
+                profiling.disable()
+        return ledger, restore
+    except Exception:
+        return None, lambda: None
+
+
+def _goodput_end(tag, ledger, restore, steps):
+    """Close the measured window and stash the compact breakdown for
+    the JSONL line under ``tag``; never fatal."""
+    try:
+        if ledger is None:
+            return None
+        from mxnet_tpu.obs import goodput as _gp
+        ledger.step(steps)
+        win = ledger.flush(reason="bench")
+        _GOODPUT[tag] = _gp.line_summary(win)
+        return _GOODPUT[tag]
+    except Exception:
+        return None
+    finally:
+        restore()
+
+
+def _goodput_extra(tag):
+    """extra_fn fields: the goodput breakdown riding the JSONL line."""
+    gp = _GOODPUT.get(tag)
+    return {"goodput": gp} if gp else {}
 
 
 # ----------------------------------------------------------------------
@@ -323,6 +387,21 @@ def _check_subprocess(out, expr):
 
 def _cpu_subprocess_value(expr, timeout=600):
     return _subprocess_value(expr, timeout=timeout, force_cpu=True)
+
+
+def _subprocess_json(expr, timeout=600):
+    """Like _subprocess_value but for an expr returning a JSON-able
+    dict (``print(json.dumps(fn()))``); returns the parsed dict."""
+    import subprocess
+    import sys
+    code = ("import sys, json; sys.path.insert(0, %r); import bench; "
+            "print(json.dumps(%s))"
+            % (_os.path.dirname(_os.path.abspath(__file__)), expr))
+    out = subprocess.run([sys.executable, "-c", code],
+                         env=dict(_os.environ), capture_output=True,
+                         text=True, timeout=timeout)
+    _check_subprocess(out, expr)
+    return json.loads(out.stdout.strip().splitlines()[-1])
 
 
 def _subprocess_pair(expr, timeout=600):
@@ -546,12 +625,18 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
     with amp_ctx:
         step.run_steps(x, y)
         float(step.run_steps(x, y).asnumpy()[-1])
+        # goodput ledger over the measured reps ONLY (the single-step
+        # flop-count compile below would pollute the recompile
+        # category); the window's breakdown rides the JSONL line
+        ledger, _restore_gp = _goodput_begin()
         wins = []
         for _ in range(reps):
             t0 = time.perf_counter()
             out = step.run_steps(x, y)
             float(out.asnumpy()[-1])
             wins.append(batch_size * k / (time.perf_counter() - t0))
+        _goodput_end("resnet50_bf16", ledger, _restore_gp,
+                     steps=k * reps)
         # single-step program for an honest per-step flop count (the scan
         # program reports its loop body once); slice ON DEVICE -- an
         # asnumpy here would fetch the whole (k, B, ...) tensor
@@ -563,6 +648,8 @@ def bench_resnet50_scan(batch_size=256, k=10, dtype="bfloat16", reps=4):
     peak = _peak_flops()
     if ca and ca.get("flops") and peak:
         mfu = round(ca["flops"] / dt / peak, 4)
+    if "resnet50_bf16" in _GOODPUT:
+        _GOODPUT["resnet50_bf16"]["mfu"] = mfu
     # persist the per-HLO cost accounting of the measured single-step
     # program next to the JSONL line (ISSUE 6 / ROADMAP item 2)
     _persist_cost_report("resnet50_bf16", step, step_time_s=dt,
@@ -602,12 +689,15 @@ def bench_resnet50_lars(batch_size=512, k=10, dtype="bfloat16", reps=3):
     with amp_ctx:
         step.run_steps(x, y)
         float(step.run_steps(x, y).asnumpy()[-1])
+        ledger, _restore_gp = _goodput_begin()
         wins = []
         for _ in range(reps):
             t0 = time.perf_counter()
             out = step.run_steps(x, y)
             float(out.asnumpy()[-1])
             wins.append(batch_size * k / (time.perf_counter() - t0))
+        _goodput_end("resnet50_lars_bf16", ledger, _restore_gp,
+                     steps=k * reps)
         step(x[0], y[0])
         ca = step.cost_analysis()
     med = statistics.median(wins)
@@ -616,6 +706,8 @@ def bench_resnet50_lars(batch_size=512, k=10, dtype="bfloat16", reps=3):
     peak = _peak_flops()
     if ca and ca.get("flops") and peak:
         mfu = round(ca["flops"] / dt / peak, 4)
+    if "resnet50_lars_bf16" in _GOODPUT:
+        _GOODPUT["resnet50_lars_bf16"]["mfu"] = mfu
     _persist_cost_report("resnet50_lars_bf16", step, step_time_s=dt,
                          items_per_step=batch_size)
     return med, mfu, [round(w, 1) for w in wins]
@@ -1058,15 +1150,20 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
     device, so later epochs are pure compute.  The timed window covers
     everything from the first decoded record to the last step's sync.
 
-    Returns ``(img/s, staging_overlap_frac)`` where the overlap
-    fraction -- the share of producer (decode+transfer) time hidden
-    behind training compute, ``1 - consumer_wait / producer_busy`` --
-    is computed from the library's ``feed.*`` telemetry instruments
-    (docs/observability.md), not bench-local accounting.  The axon
-    tunnel's H2D throughput swings by orders of magnitude (see the
-    env_health line / docs/perf_resnet50.md); when transfers dominate,
-    the overlap fraction plus the health probe make the bottleneck
-    attributable in the artifact itself.
+    Returns ``(img/s, staging_overlap_frac, goodput)`` where the
+    overlap fraction -- the share of producer (decode+transfer) time
+    hidden behind training compute, ``1 - consumer_wait /
+    producer_busy`` -- is computed from the library's ``feed.*``
+    telemetry instruments (docs/observability.md), not bench-local
+    accounting, and ``goodput`` is the StepLedger's per-category wall
+    attribution + bottleneck verdict over the timed window (ISSUE 14:
+    the e2e-vs-synthetic gap is auto-attributed -- an input-bound
+    verdict here names decode/transfer with numbers instead of a
+    hand-read of feed counters).  The axon tunnel's H2D throughput
+    swings by orders of magnitude (see the env_health line /
+    docs/perf_resnet50.md); when transfers dominate, the breakdown
+    plus the health probe make the bottleneck attributable in the
+    artifact itself.
     """
     import contextlib
     import shutil
@@ -1122,6 +1219,12 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
                 step(zx, zy)
             float(step(zx, zy).asscalar())
 
+            # goodput ledger over the timed window (decode -> stage ->
+            # train): the breakdown rides the e2e JSONL line
+            ledger, _restore_gp = _goodput_begin()
+            if ledger is not None:
+                ledger.flops_per_step = \
+                    lambda: (step.cost_analysis() or {}).get("flops")
             count = 0
             last = None
             staged = []
@@ -1139,6 +1242,8 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
                     count += batch_size
             float(last.asscalar())
             dt = time.perf_counter() - t_start
+            goodput = _goodput_end("resnet50_e2e", ledger, _restore_gp,
+                                   steps=count // batch_size)
         busy = telemetry.timer("feed.producer_busy").sum
         wait = telemetry.timer("feed.consumer_wait").sum
         overlap = max(0.0, 1.0 - wait / busy) if busy > 0 else 0.0
@@ -1150,7 +1255,17 @@ def bench_resnet50_e2e(batch_size=256, n_images=2048, dtype="bfloat16",
         if not was_enabled:
             telemetry.disable()
         shutil.rmtree(tmp, ignore_errors=True)
-    return count / dt, round(overlap, 3)
+    return count / dt, round(overlap, 3), goodput
+
+
+def _e2e_line(batch_size, dtype="bfloat16", **kw):
+    """The dict the e2e subprocess prints as JSON (rate + overlap +
+    goodput breakdown ride one line back to the parent)."""
+    rate, overlap, goodput = bench_resnet50_e2e(batch_size,
+                                                dtype=dtype, **kw)
+    return {"img_per_s": round(rate, 1),
+            "staging_overlap_frac": overlap,
+            "goodput": goodput}
 
 
 
@@ -1232,6 +1347,7 @@ def main():
                           "max": max(rn_out.get("wins") or [0]),
                           "windows": rn_out.get("wins"),
                           **_cost_extra("resnet50_bf16"),
+                          **_goodput_extra("resnet50_bf16"),
                           **_kernels_diff_extra("resnet")})
 
     # -- 2: headline BERT (bs=256 is the single-chip knee, r4) --------
@@ -1305,7 +1421,8 @@ def main():
                    "optimizer": "lars"},
             extra_fn=lambda: {"mfu": lars_out.get("mfu"),
                               "windows": lars_out.get("wins"),
-                              **_cost_extra("resnet50_lars_bf16")})
+                              **_cost_extra("resnet50_lars_bf16"),
+                              **_goodput_extra("resnet50_lars_bf16")})
 
     # MULTICHIP scaling line (ISSUE 9 bench contract): 1/2/4/8-device
     # SPMD train step, per-host efficiency + in-graph collective bytes
@@ -1409,14 +1526,18 @@ def main():
     if on_tpu and _budget_ok("resnet50_imagenet_train_e2e_bf16", 600):
         try:
             # fresh subprocess: the dataset staging transfer must happen
-            # before any compute touches this process's tunnel
-            e2e, overlap = _subprocess_pair(
-                "bench.bench_resnet50_e2e(%d, dtype='bfloat16')"
-                % (rn_bs * 2),
+            # before any compute touches this process's tunnel.  The
+            # child prints rate + overlap + the goodput breakdown as
+            # one JSON object, so the e2e-vs-synthetic gap arrives
+            # auto-attributed (ISSUE 14).
+            rec = _subprocess_json(
+                "bench._e2e_line(%d, dtype='bfloat16')" % (rn_bs * 2),
                 timeout=max(300, min(900, int(_remaining()) - 60)))
             _print_line({"metric": "resnet50_imagenet_train_e2e_bf16",
-                         "value": round(e2e, 1), "unit": "img/s",
-                         "staging_overlap_frac": overlap,
+                         "value": rec["img_per_s"], "unit": "img/s",
+                         "staging_overlap_frac":
+                         rec["staging_overlap_frac"],
+                         "goodput": rec.get("goodput"),
                          "vs_baseline": None})
         except Exception as e:
             _print_line({"metric": "resnet50_imagenet_train_e2e_bf16",

@@ -80,9 +80,14 @@
 #          serving.dispatch_time timer; a chaos KILL mid-commit
 #          (seed 0) with the flight recorder installed -- the process
 #          dies 137 and the blackbox dump's final events must name the
-#          injected fault and the in-flight trace; and a /healthz flip
+#          injected fault and the in-flight trace; a /healthz flip
 #          gate -- READY while the watcher is good, NOT_READY after
-#          the swap failure budget suspends it
+#          the swap failure budget suspends it; and the goodput gate
+#          -- a ContinuousTrainer fed through a DeviceFeed with a
+#          chaos sleep injected on feed.produce must close windows
+#          whose reconciliation (categories sum to wall within tol)
+#          holds on EVERY window, read input-bound, and emit a
+#          goodput.regression event NAMING input_wait
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -1010,6 +1015,71 @@ assert any(r.startswith("watcher_suspended:m") for r in body["reasons"])
 reg.shutdown(drain=True); watcher.close(); ct.close(); obs.server.stop()
 print("obs healthz gate ok: READY -> NOT_READY on suspension "
       "(reasons=%s)" % body["reasons"])
+EOF
+    log "obs: goodput gate -- injected feed stall must read input-bound"
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 MXNET_TPU_OBS_GOODPUT=1 \
+        MXNET_TPU_OBS_GOODPUT_WINDOW=4 python - <<'EOF'
+import tempfile
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, obs, telemetry
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.dataio import DeviceFeed
+from mxnet_tpu.obs import goodput
+from mxnet_tpu.serving.loop import ContinuousTrainer
+
+assert obs.goodput_enabled(), "MXNET_TPU_OBS_GOODPUT=1 did not arm"
+assert mx.runtime.Features().is_enabled("OBS_GOODPUT")
+net, trainer, loss_fn, (x, y) = scenarios.train_fixtures(seed=0)
+xn, yn = x.asnumpy(), y.asnumpy()
+
+
+def batches():
+    while True:
+        yield (xn, yn)
+
+
+# the PRODUCT wiring: ContinuousTrainer ticks the process ledger every
+# step; its data callable pulls staged batches off a DeviceFeed, so
+# the feed.produce chaos rule below starves the consumer for real
+feed = DeviceFeed(batches(), ctx=mx.cpu())
+
+
+def data(step):
+    b = next(feed)
+    return b.data, b.label
+
+
+ct = ContinuousTrainer(net, trainer, loss_fn, data,
+                       tempfile.mkdtemp(), publish_every=10 ** 6)
+ct.run_steps(20)                         # 5 healthy windows = baseline
+led = goodput.ledger()
+healthy = led.windows()
+assert len(healthy) == 5, len(healthy)
+# injected chaos stall on the input path: input_wait must dominate
+chaos.arm(seed=0)
+chaos.on("feed.produce", action=chaos.sleep(0.03))
+ct.run_steps(12)                         # 3 stalled windows
+chaos.disarm(); chaos.reset()
+ct.close()
+feed.close()
+wins = led.windows()
+# the reconciliation contract holds on EVERY window (sum == wall
+# within tol; only overshoot/double-counting can break it)
+for w in wins:
+    assert w["reconciliation"]["ok"], w["reconciliation"]
+stalled = [w for w in wins[5:] if w["steps"]]
+assert stalled, "no stalled windows closed"
+last = stalled[-1]
+assert last["verdict"]["bound"] == "input", last["verdict"]
+assert last["categories"]["input_wait"]["share"] > 0.5, \
+    last["categories"]
+# the sentinel NAMED the category that moved
+regs = telemetry.event("goodput.regression").recent
+assert any(r["category"] == "input_wait" for r in regs), regs
+assert telemetry.counter("goodput.env_degraded_windows").value == 0
+print("obs goodput gate ok: %d windows reconciled, verdict=%r, "
+      "sentinel named input_wait"
+      % (len(wins), last["verdict"]["detail"]))
 EOF
     rm -rf "$obsdir"
 }

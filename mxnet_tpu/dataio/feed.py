@@ -259,9 +259,17 @@ class DeviceFeed:
         wself = weakref.ref(self)
 
         def run():
+            from .. import chaos as _chaos
             out = _END
             try:
                 while not stop.is_set():
+                    # chaos fail point on the input path (ISSUE 14):
+                    # a seeded sleep rule here stalls the producer so
+                    # the goodput ledger's input_wait category must
+                    # dominate -- CI's obs stage injects it and gates
+                    # that the sentinel names input_wait.  Disarmed:
+                    # one module-flag check.
+                    _chaos.fail_point("feed.produce")
                     # busy window = host batch production (decode/
                     # batchify) + async transfer issue; the blocking
                     # put below is backpressure, not work, and stays

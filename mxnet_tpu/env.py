@@ -334,6 +334,36 @@ _VARS = [
            "registry), /statusz (served/published step, swap history, "
            "bucket occupancy, per-rank heartbeats).  0 (default) = "
            "not started; obs.serve(0) binds an ephemeral port."),
+    EnvVar("MXNET_TPU_OBS_GOODPUT", bool, False,
+           "'1' arms the goodput ledger (mx.obs.goodput): the "
+           "ContinuousTrainer loop ticks a per-process StepLedger that "
+           "decomposes every rolling window of training steps into "
+           "device_compute / input_wait / host_sync / checkpoint_stall "
+           "/ recompile / other (reconciled to window wall within "
+           "MXNET_TPU_OBS_GOODPUT_TOL), publishes a rolling MFU gauge, "
+           "and runs the EWMA+MAD regression sentinel (goodput.* "
+           "instruments, /statusz goodput section).  Needs "
+           "MXNET_TPU_TELEMETRY=1 for non-empty attribution.  Off "
+           "(default): one module-flag check per loop step.  Runtime "
+           "toggle: obs.enable_goodput()/disable_goodput()."),
+    EnvVar("MXNET_TPU_OBS_GOODPUT_WINDOW", int, 20,
+           "Training steps per goodput-ledger window: the attribution "
+           "granularity AND the sentinel's sample size.  Smaller = "
+           "faster regression detection, noisier baselines.  "
+           "Per-ledger override: StepLedger(window_steps=...)."),
+    EnvVar("MXNET_TPU_OBS_GOODPUT_TOL", float, 0.25,
+           "Reconciliation tolerance of the goodput ledger: the "
+           "attributed categories may exceed the window wall by at "
+           "most this fraction before the window's reconciliation "
+           "contract reads failed ('other' absorbs undershoot, so "
+           "only overshoot -- double counting -- can violate it).  "
+           "CI gates ok on every window (ci/run_all.sh obs)."),
+    EnvVar("MXNET_TPU_OBS_GOODPUT_MAD_K", float, 4.0,
+           "Regression-sentinel sensitivity: a category regresses when "
+           "its per-step seconds exceed EWMA mean + this many EWMA "
+           "absolute deviations (and the move is at least 5% of the "
+           "window wall).  Per-ledger override: "
+           "StepLedger(mad_k=...)."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

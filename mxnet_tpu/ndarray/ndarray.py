@@ -20,6 +20,7 @@ import contextlib
 import functools
 import os
 import struct
+import time
 
 import numpy as np
 
@@ -61,8 +62,7 @@ def waitall():
     failed cannot be resurrected, but every live array's pending work is
     drained and the first failure propagates.
     """
-    if _telemetry._ENABLED:
-        _telemetry.hooks.host_sync("waitall")
+    t0 = time.perf_counter() if _telemetry._ENABLED else None
     bulk.flush()
     if hasattr(jax, "effects_barrier"):
         jax.effects_barrier()
@@ -70,6 +70,8 @@ def waitall():
         if isinstance(d, jax.core.Tracer):
             continue
         d.block_until_ready()
+    if t0 is not None:
+        _telemetry.hooks.host_sync("waitall", time.perf_counter() - t0)
 
 
 def _is_traced(x):
@@ -179,7 +181,11 @@ class NDArray:
     def asnumpy(self):
         """Blocking copy to host (reference: ``MXNDArraySyncCopyToCPU``)."""
         if _telemetry._ENABLED:
-            _telemetry.hooks.host_sync("asnumpy")
+            t0 = time.perf_counter()
+            out = np.asarray(self._data)
+            _telemetry.hooks.host_sync("asnumpy",
+                                       time.perf_counter() - t0)
+            return out
         return np.asarray(self._data)
 
     def __array__(self, dtype=None, copy=None):
@@ -224,7 +230,12 @@ class NDArray:
 
     def wait_to_read(self):
         if _telemetry._ENABLED:
-            _telemetry.hooks.host_sync("wait_to_read")
+            t0 = time.perf_counter()
+            if not _is_traced(self._data):
+                self._data.block_until_ready()
+            _telemetry.hooks.host_sync("wait_to_read",
+                                       time.perf_counter() - t0)
+            return
         if not _is_traced(self._data):
             self._data.block_until_ready()
 

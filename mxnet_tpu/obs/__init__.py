@@ -17,7 +17,13 @@ a crash-safe flight recorder, and live HTTP introspection.
 - **introspection** (``obs.server``): ``/healthz`` (watcher failure
   budget + writer errors + queue saturation), ``/metrics`` (Prometheus
   exposition), ``/statusz`` (served/published step, swap history,
-  heartbeats) on ``MXNET_TPU_OBS_PORT``.
+  heartbeats) on ``MXNET_TPU_OBS_PORT``;
+- **goodput ledger** (``obs.goodput``, ISSUE 14): per-window step-time
+  attribution (device_compute / input_wait / host_sync /
+  checkpoint_stall / recompile / other, reconciled to window wall),
+  a rolling MFU gauge, and an EWMA+MAD regression sentinel guarded by
+  the env.* health gauges; armed by ``MXNET_TPU_OBS_GOODPUT=1`` /
+  ``obs.enable_goodput()``.
 
 Tracing is gated exactly like telemetry: disabled (the default), every
 instrumented site pays ONE module-flag check (``obs._TRACE_ENABLED``)
@@ -29,21 +35,27 @@ from __future__ import annotations
 
 import os
 
-from . import flight, status, trace
+from . import flight, goodput, status, trace
 from .trace import (TraceContext, begin_span, current, end_span,
                     export_chrome_trace, record_span, span, spans)
 from .trace import trace as start_trace
 
 __all__ = [
     "enable_tracing", "disable_tracing", "tracing_enabled",
+    "enable_goodput", "disable_goodput", "goodput_enabled",
     "start_trace", "span", "begin_span", "end_span", "record_span",
     "current", "spans", "export_chrome_trace", "TraceContext",
-    "flight", "status", "server", "serve", "install_blackbox",
+    "flight", "goodput", "status", "server", "serve",
+    "install_blackbox",
 ]
 
 # THE flag every traced hot path checks (one module-attribute read).
 # Mutate only through enable_tracing()/disable_tracing().
 _TRACE_ENABLED = False
+
+# THE flag the goodput-ledger hook sites check (ContinuousTrainer's
+# step/publish loop); same zero-overhead contract as _TRACE_ENABLED.
+_GOODPUT_ENABLED = False
 
 
 def enable_tracing():
@@ -62,6 +74,24 @@ def tracing_enabled():
     return _TRACE_ENABLED
 
 
+def enable_goodput():
+    """Arm the goodput-ledger loop hooks (idempotent; the ledger reads
+    telemetry instruments, so enable telemetry too for non-empty
+    category attribution)."""
+    global _GOODPUT_ENABLED
+    _GOODPUT_ENABLED = True
+
+
+def disable_goodput():
+    """Disarm the goodput hooks; recorded windows are kept."""
+    global _GOODPUT_ENABLED
+    _GOODPUT_ENABLED = False
+
+
+def goodput_enabled():
+    return _GOODPUT_ENABLED
+
+
 def install_blackbox(path=None, capacity=None):
     """Install the process flight recorder (see ``obs.flight``)."""
     return flight.install(path, capacity=capacity)
@@ -78,6 +108,8 @@ from . import server  # noqa: E402  (handler imports status above)
 # env arming (same != "0" convention as telemetry)
 if os.environ.get("MXNET_TPU_OBS_TRACE", "0") != "0":
     enable_tracing()
+if os.environ.get("MXNET_TPU_OBS_GOODPUT", "0") != "0":
+    enable_goodput()
 _env_blackbox = os.environ.get("MXNET_TPU_OBS_BLACKBOX", "")
 if _env_blackbox:
     flight.install(_env_blackbox)

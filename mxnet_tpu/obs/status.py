@@ -26,11 +26,12 @@ import time
 import weakref
 
 __all__ = ["register_watcher", "register_registry", "register_trainer",
-           "heartbeat", "health", "statusz", "reset"]
+           "register_ledger", "heartbeat", "health", "statusz", "reset"]
 
 _watchers = weakref.WeakSet()
 _registries = weakref.WeakSet()
 _trainers = weakref.WeakSet()
+_ledgers = weakref.WeakSet()    # goodput StepLedgers (obs.goodput)
 _heartbeats = {}                # rank -> wall time of last beat
 
 
@@ -53,6 +54,10 @@ def register_trainer(trainer):
     _trainers.add(trainer)
 
 
+def register_ledger(ledger):
+    _ledgers.add(ledger)
+
+
 def heartbeat(rank=None):
     """One liveness beat (the trainer loop calls this every step)."""
     _heartbeats[_rank() if rank is None else int(rank)] = time.time()
@@ -63,6 +68,7 @@ def reset():
     _watchers.clear()
     _registries.clear()
     _trainers.clear()
+    _ledgers.clear()
     _heartbeats.clear()
 
 
@@ -134,6 +140,14 @@ def statusz():
                                   "buckets": list(s.buckets)})
             except Exception:
                 continue
+    goodput = None
+    for led in list(_ledgers):
+        try:
+            win = led.last()
+        except Exception:
+            continue
+        if win is not None:
+            goodput = win       # newest registered ledger wins
     swap_ev = reg.get("serving.swap")
     occupancy = reg.get("serving.batch_occupancy")
     served = reg.get("serving.served_step")
@@ -154,5 +168,6 @@ def statusz():
         "swap_history": swap_ev.recent if swap_ev is not None else [],
         "bucket_occupancy": (occupancy.snapshot()
                              if occupancy is not None else None),
+        "goodput": goodput,     # latest StepLedger window (obs.goodput)
         "heartbeats": dict(_heartbeats),
     }

@@ -36,7 +36,7 @@ import os
 __all__ = [
     "enable", "disable", "enabled", "reset",
     "capture_jit", "record_step", "reports", "combined_report",
-    "save_reports", "report_for", "report_dir",
+    "save_reports", "report_for", "report_dir", "flops_per_step",
     "CATEGORIES",
 ]
 
@@ -125,6 +125,14 @@ def combined_report():
     rollup) -- the artifact ``mxprof report``/``diff`` consume."""
     from . import store
     return store.combined()
+
+
+def flops_per_step(label=None):
+    """FLOPs of one dispatch of the labeled captured executable
+    (default: the first train_step) -- the goodput ledger's
+    window-flops source.  None when nothing matches."""
+    from . import store
+    return store.flops_per_step(label)
 
 
 def save_reports(dirpath=None):

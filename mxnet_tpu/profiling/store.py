@@ -157,6 +157,21 @@ def reports():
     return [(_annotate(r) if steps_snapshot else r) for r in reps]
 
 
+def flops_per_step(label=None):
+    """FLOPs of ONE dispatch of the labeled executable (``label=None``
+    picks the first ``train_step``-kind report) from the materialized
+    CostReports -- the goodput ledger's window-flops source
+    (``obs.goodput.StepLedger(flops_per_step=...)``): window MFU =
+    flops_per_step x steps / wall / device peak.  Materializes lazily
+    (jax executable-cache hit for anything already dispatched); None
+    when nothing matches."""
+    for rep in reports():
+        if (rep["label"] == label
+                or (label is None and rep.get("kind") == "train_step")):
+            return rep["totals"]["flops"]
+    return None
+
+
 def combined():
     """The combined artifact ``mxprof report`` / ``diff`` consume."""
     reps = reports()

@@ -66,6 +66,9 @@ def _detect():
         # request/step tracing (mx.obs): LIVE arm state, same contract
         # as the TELEMETRY row
         "OBS_TRACE": _obs_tracing(),
+        # goodput ledger (mx.obs.goodput): LIVE arm state of the
+        # per-window step-time attribution + regression sentinel
+        "OBS_GOODPUT": _obs_goodput(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -78,6 +81,11 @@ def _telemetry_enabled():
 def _obs_tracing():
     from . import obs
     return obs.tracing_enabled()
+
+
+def _obs_goodput():
+    from . import obs
+    return obs.goodput_enabled()
 
 
 def _tsan_enabled():

@@ -140,6 +140,11 @@ class ContinuousTrainer:
             finally:
                 if _sp is not None:
                     _obs.end_span(_sp)
+            if _obs._GOODPUT_ENABLED:
+                # one ledger tick per training step: windows close at
+                # the MXNET_TPU_OBS_GOODPUT_WINDOW boundary and the
+                # attribution publishes through goodput.* instruments
+                _obs.goodput.ledger().step()
             # liveness beat for /statusz: a stale heartbeat means a
             # wedged loop even when every thread is technically alive
             _obs.status.heartbeat()
@@ -161,6 +166,10 @@ class ContinuousTrainer:
                 _obs.end_span(_sp)
         with self._lock:
             self._published_step = step
+        if _obs._GOODPUT_ENABLED:
+            # the ledger's publish guard: the checkpoint_stall spike
+            # this window is expected work, not a regression
+            _obs.goodput.ledger().note_publish()
         if _telemetry._ENABLED:
             _telemetry.hooks.train_publish(step,
                                            time.perf_counter() - t0)
@@ -202,6 +211,10 @@ class ContinuousTrainer:
             t.join()
             self._thread = None
         self.manager.wait_until_finished()
+        if _obs._GOODPUT_ENABLED:
+            # close the partial tail window so a short run still
+            # reports its attribution
+            _obs.goodput.ledger().flush(reason="close")
         with self._lock:
             err, self._error = self._error, None
         if err is not None:

@@ -92,6 +92,7 @@ def summarize_file(path):
     span_folds = {}
     records = skipped = 0
     rank = None
+    goodput_active = None     # last goodput.window payload WITH steps
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -140,6 +141,13 @@ def summarize_file(path):
                     name, {"count": 0, "last_payload": None})
                 agg["count"] += 1
                 agg["last_payload"] = rec.get("payload")
+                # the goodput verdict must come from the last ACTIVE
+                # window -- a zero-step tail flush (trainer close,
+                # serving-only lull) reads "idle" and must not mask it
+                if name == "goodput.window" \
+                        and isinstance(rec.get("payload"), dict) \
+                        and rec["payload"].get("steps"):
+                    goodput_active = rec["payload"]
             elif kind == "snapshot.counter":
                 counters[name] = rec.get("value", 0)
             elif kind == "snapshot.gauge":
@@ -229,8 +237,59 @@ def summarize_file(path):
                                        {}).get("value"),
         },
         "serving": _serving_section(counters, timers),
+        "goodput": _goodput_section(counters, gauges, timers, events,
+                                    goodput_active),
     }
     return result
+
+
+# the ledger's category order (mirrors obs.goodput.CATEGORIES; literal
+# here so offline summarize never imports the obs package)
+_GOODPUT_CATEGORIES = ("device_compute", "input_wait", "host_sync",
+                       "checkpoint_stall", "recompile", "other")
+
+
+def _goodput_section(counters, gauges, timers, events,
+                     last_active=None):
+    """Rollup of the goodput.* instruments (obs.goodput StepLedger):
+    per-category attributed seconds (timer sums -- exact across the
+    whole run), the latest window's verdict, and the sentinel's
+    regression/env-degraded tallies."""
+    windows = counters.get("goodput.windows",
+                           events.get("goodput.window",
+                                      {}).get("count", 0))
+    if not windows:
+        return {"windows": 0}
+    steps = counters.get("goodput.steps", 0)
+    cats = {}
+    total = 0.0
+    for cat in _GOODPUT_CATEGORIES:
+        s = timers.get("goodput.%s_s" % cat, {}).get("sum") or 0.0
+        cats[cat] = {"total_s": round(s, 6)}
+        total += s
+    for cat in cats:
+        cats[cat]["share"] = round(cats[cat]["total_s"] / total, 4) \
+            if total > 0 else None
+        cats[cat]["per_step_s"] = round(cats[cat]["total_s"] / steps, 6) \
+            if steps else None
+    last = last_active \
+        or events.get("goodput.window", {}).get("last_payload") or {}
+    return {
+        "windows": windows,
+        "steps": steps,
+        "wall_s": round(total, 6),
+        "categories": cats,
+        "mfu": gauges.get("goodput.mfu", {}).get("value"),
+        "verdict": last.get("verdict"),
+        "bound": last.get("bound"),
+        "reconciliation_error":
+        gauges.get("goodput.reconciliation_error", {}).get("value"),
+        "regressions": counters.get("goodput.regressions", 0),
+        "last_regression": events.get("goodput.regression",
+                                      {}).get("last_payload"),
+        "env_degraded_windows":
+        counters.get("goodput.env_degraded_windows", 0),
+    }
 
 
 def _serving_section(counters, timers):
@@ -275,6 +334,7 @@ def summarize_files(paths, skew_threshold=1.25):
         records += agg["records"]
         st = agg["steps"]
         rank = agg["rank"] if agg["rank"] is not None else i
+        gp = agg.get("goodput") or {}
         per_rank.append({
             "file": path,
             "rank": rank,
@@ -283,6 +343,10 @@ def summarize_files(paths, skew_threshold=1.25):
             "mean_step_s": st["mean_s"],
             "total_step_s": st["total_s"],
             "samples_per_sec": st["samples_per_sec"],
+            # per-step goodput category seconds (None without a ledger)
+            "goodput": {cat: c["per_step_s"]
+                        for cat, c in gp.get("categories", {}).items()}
+            if gp.get("windows") else None,
         })
     means = sorted(r["mean_step_s"] for r in per_rank
                    if r["mean_step_s"])
@@ -309,8 +373,45 @@ def summarize_files(paths, skew_threshold=1.25):
             "threshold": skew_threshold,
             "straggler": bool(stragglers),
             "straggler_ranks": stragglers,
+            # ISSUE 14 satellite: name WHICH goodput category differs
+            # on the slow rank, not just that it is slow
+            "category_attribution": _straggler_categories(per_rank,
+                                                          stragglers),
         },
     }
+
+
+def _straggler_categories(per_rank, stragglers):
+    """For each straggler rank, the goodput category whose per-step
+    seconds deviate most from the cross-rank median -- e.g. "rank 2
+    input_wait 3.1x median".  Empty when no rank carries ledger data
+    (the skew verdict itself still works from step timers alone)."""
+    ranks_with = [r for r in per_rank if r.get("goodput")]
+    if not stragglers or len(ranks_with) < 2:
+        return []
+    medians = {}
+    for cat in _GOODPUT_CATEGORIES:
+        vals = sorted(r["goodput"].get(cat) or 0.0 for r in ranks_with)
+        medians[cat] = vals[(len(vals) - 1) // 2]
+    out = []
+    for r in ranks_with:
+        if r["rank"] not in stragglers:
+            continue
+        best = None
+        for cat in _GOODPUT_CATEGORIES:
+            if cat == "other":
+                continue
+            v = r["goodput"].get(cat) or 0.0
+            ratio = v / max(medians[cat], 1e-9)
+            if v > medians[cat] and (best is None
+                                     or ratio > best["ratio"]):
+                best = {"rank": r["rank"], "category": cat,
+                        "per_step_s": round(v, 6),
+                        "median_per_step_s": round(medians[cat], 6),
+                        "ratio": round(min(ratio, 999.0), 2)}
+        if best is not None:
+            out.append(best)
+    return out
 
 
 def _render_ranks(agg):
@@ -332,6 +433,13 @@ def _render_ranks(agg):
             % (sk["max_over_median"], sk["threshold"],
                "STRAGGLER rank(s) %s" % sk["straggler_ranks"]
                if sk["straggler"] else "balanced"))
+        for attr in sk.get("category_attribution") or ():
+            lines.append(
+                "  rank %s slow: %s %.1fx median "
+                "(%.1fms vs %.1fms per step)"
+                % (attr["rank"], attr["category"], attr["ratio"],
+                   1e3 * attr["per_step_s"],
+                   1e3 * attr["median_per_step_s"]))
     return "\n".join(lines)
 
 
@@ -430,6 +538,28 @@ def _render_human(agg):
                fd["producer_busy_s"] or 0.0, fd["consumer_wait_s"] or 0.0,
                ", overlap %.1f%%" % (100 * fd["overlap_frac"])
                if fd.get("overlap_frac") is not None else ""))
+    gp = agg.get("goodput") or {}
+    if gp.get("windows"):
+        shares = ", ".join(
+            "%s %.0f%%" % (cat, 100 * gp["categories"][cat]["share"])
+            for cat in _GOODPUT_CATEGORIES
+            if gp["categories"][cat]["share"])
+        lines.append(
+            "  goodput: %d windows / %d steps%s%s%s"
+            % (gp["windows"], gp["steps"],
+               " (%s)" % shares if shares else "",
+               ", mfu %.3f" % gp["mfu"] if gp.get("mfu") is not None
+               else "",
+               ", %d regressions" % gp["regressions"]
+               if gp.get("regressions") else ""))
+        if gp.get("verdict"):
+            # THE bottleneck verdict line, e.g. "input-bound: feed
+            # supplies 54% of device demand"
+            lines.append("  bottleneck: %s%s"
+                         % (gp["verdict"],
+                            " [env degraded: %d windows]"
+                            % gp["env_degraded_windows"]
+                            if gp.get("env_degraded_windows") else ""))
     spn = agg.get("spans") or {}
     if spn:
         lines.append("  spans: %d recorded over %d names (top: %s)"

@@ -36,6 +36,7 @@ __all__ = [
     "checkpoint_retry", "checkpoint_write_failed",
     "preemption_reentry", "chaos_inject", "chaos_survive",
     "serving_watcher_suspended", "env_health",
+    "goodput_window", "goodput_regression", "goodput_env_degraded",
 ]
 
 
@@ -52,10 +53,14 @@ def op_dispatch(opname):
     reg.counter("dispatch.op." + opname).inc()
 
 
-def host_sync(kind):
+def host_sync(kind, seconds=None):
     reg = _registry()
     reg.counter("dispatch.host_sync").inc()
     reg.counter("dispatch.host_sync." + kind).inc()
+    if seconds is not None:
+        # the goodput ledger's host_sync category: wall the host spent
+        # blocked on device results (asnumpy / wait_to_read / waitall)
+        reg.timer("dispatch.host_sync_time").observe(seconds, sync=kind)
 
 
 def compile_event(site, seconds=None, retrace=False, **payload):
@@ -336,6 +341,58 @@ def serving_watcher_suspended(model, step, budget):
                                                 budget=budget)
 
 
+def goodput_window(report):
+    """One StepLedger window closed (obs.goodput): publish the
+    attribution as gauges (shares, MFU -- the live/Prometheus view),
+    timers (per-category seconds -- the per-rank offline view: timer
+    sums survive into summarize, so rank files carry per-category
+    totals), and one compact ``goodput.window`` event."""
+    reg = _registry()
+    reg.counter("goodput.windows").inc()
+    if report["steps"]:
+        reg.counter("goodput.steps").inc(int(report["steps"]))
+    for cat, c in report["categories"].items():
+        reg.timer("goodput." + cat + "_s").observe(c["seconds"])
+        reg.gauge("goodput." + cat + "_share").set(c["share"])
+    if report.get("mfu") is not None:
+        reg.gauge("goodput.mfu").set(report["mfu"])
+    reg.gauge("goodput.reconciliation_error").set(
+        report["reconciliation"]["error"])
+    reg.event("goodput.window").emit(
+        index=report["index"], reason=report["reason"],
+        steps=report["steps"], wall_s=round(report["wall_s"], 6),
+        mfu=report.get("mfu"),
+        shares={cat: round(c["share"], 4)
+                for cat, c in report["categories"].items()},
+        verdict=report["verdict"]["detail"],
+        bound=report["verdict"]["bound"],
+        reconciled=report["reconciliation"]["ok"],
+        env_degraded=report["env_degraded"])
+
+
+def goodput_regression(category, per_step_s, baseline_per_step_s,
+                       ratio, window):
+    """The sentinel flagged one category as regressed vs its EWMA+MAD
+    baseline -- the event NAMES the category that moved."""
+    reg = _registry()
+    reg.counter("goodput.regressions").inc()
+    reg.event("goodput.regression").emit(
+        category=category, per_step_s=per_step_s,
+        baseline_per_step_s=baseline_per_step_s, ratio=ratio,
+        window=window)
+
+
+def goodput_env_degraded(window, dispatch_roundtrip_us):
+    """The sentinel's env guard tripped: the window ran on a degraded
+    environment (tunnel), so it is reported HERE and not as a
+    regression -- the r05 lesson, and the event the bench's per-line
+    ``degraded_env`` flag must agree with (test_bench_contract)."""
+    reg = _registry()
+    reg.counter("goodput.env_degraded_windows").inc()
+    reg.event("goodput.env_degraded").emit(
+        window=window, dispatch_roundtrip_us=dispatch_roundtrip_us)
+
+
 def env_health(dispatch_roundtrip_us, h2d_mb_per_s=None):
     """The bench environment-health probe's numbers, recorded so the
     basis of a `degraded_env` verdict appears in summarize and in the
@@ -563,6 +620,42 @@ INSTRUMENTS = [
         "per-point survived count"),
     _ii("chaos.survive", "event", "chaos", 12,
         "one tolerated fault; payload carries point + how"),
+    _ii("dispatch.host_sync_time", "timer", "ndarray", 14,
+        "wall the host spent blocked on device results "
+        "(asnumpy/wait_to_read/waitall) -- the goodput ledger's "
+        "host_sync category"),
+    _ii("goodput.windows", "counter", "goodput", 14,
+        "StepLedger windows closed"),
+    _ii("goodput.steps", "counter", "goodput", 14,
+        "training steps attributed by the ledger"),
+    _ii("goodput.<category>_s", "timer", "goodput", 14,
+        "per-window seconds attributed to the category "
+        "(device_compute/input_wait/host_sync/checkpoint_stall/"
+        "recompile/other); timer sums give per-rank category totals "
+        "offline"),
+    _ii("goodput.<category>_share", "gauge", "goodput", 14,
+        "last window's share of wall per category"),
+    _ii("goodput.mfu", "gauge", "goodput", 14,
+        "rolling MFU: window flops (executable cost report) / wall / "
+        "device peak"),
+    _ii("goodput.reconciliation_error", "gauge", "goodput", 14,
+        "last window's attribution overshoot vs wall (0 unless "
+        "categories double-count; CI gates <= tol)"),
+    _ii("goodput.window", "event", "goodput", 14,
+        "one closed window; payload carries steps/wall/shares/mfu + "
+        "the bottleneck verdict sentence"),
+    _ii("goodput.regressions", "counter", "goodput", 14,
+        "windows where the sentinel flagged a category vs its "
+        "EWMA+MAD baseline"),
+    _ii("goodput.regression", "event", "goodput", 14,
+        "one flagged regression; payload NAMES the category that "
+        "moved (per-step seconds vs baseline, ratio)"),
+    _ii("goodput.env_degraded_windows", "counter", "goodput", 14,
+        "windows the sentinel attributed to a degraded environment "
+        "(env guard) instead of a regression"),
+    _ii("goodput.env_degraded", "event", "goodput", 14,
+        "one env-guarded window; payload carries the dispatch RTT -- "
+        "must agree with the bench line's degraded_env flag"),
     _ii("env.dispatch_roundtrip_us", "gauge", "bench", 13,
         "bench env-health dispatch round trip (the degraded_env "
         "basis)"),

@@ -61,6 +61,39 @@ def bench_mod(monkeypatch):
                              "collective_bytes": 67884}])
     monkeypatch.setattr(bench, "_subprocess_pair",
                         lambda *a, **k: (2000.0, 0.8))
+    # the e2e subprocess now ships rate + overlap + goodput breakdown
+    # as one JSON object (ISSUE 14)
+    _e2e_goodput = {
+        "steps": 32, "wall_s": 4.1, "mfu": 0.21,
+        "shares": {"device_compute": 0.41, "input_wait": 0.46,
+                   "host_sync": 0.02, "checkpoint_stall": 0.0,
+                   "recompile": 0.0, "other": 0.11},
+        "verdict": "input-bound: feed supplies 47% of device demand",
+        "bound": "input", "reconciled": True, "env_degraded": False}
+    monkeypatch.setattr(
+        bench, "_subprocess_json",
+        lambda *a, **k: {"img_per_s": 2000.0,
+                         "staging_overlap_frac": 0.8,
+                         "goodput": _e2e_goodput})
+    # the scan/LARS configs stash their ledger windows here (stubbed
+    # fns skip the real ledger; the shape is the contract)
+    monkeypatch.setattr(bench, "_GOODPUT", {
+        "resnet50_bf16": {
+            "steps": 40, "wall_s": 3.9, "mfu": 0.29,
+            "shares": {"device_compute": 0.93, "input_wait": 0.0,
+                       "host_sync": 0.01, "checkpoint_stall": 0.0,
+                       "recompile": 0.0, "other": 0.06},
+            "verdict": "compute-bound: device busy 93% of wall",
+            "bound": "compute", "reconciled": True,
+            "env_degraded": False},
+        "resnet50_lars_bf16": {
+            "steps": 30, "wall_s": 3.2, "mfu": 0.27,
+            "shares": {"device_compute": 0.9, "input_wait": 0.0,
+                       "host_sync": 0.01, "checkpoint_stall": 0.0,
+                       "recompile": 0.0, "other": 0.09},
+            "verdict": "compute-bound: device busy 90% of wall",
+            "bound": "compute", "reconciled": True,
+            "env_degraded": False}})
     # the kernel-tier HLO diff compiles two probe models; stub it with
     # the contract shape (the REAL probe is covered by test_kernels.py)
     monkeypatch.setattr(
@@ -343,6 +376,95 @@ def test_multichip_scaling_real_two_device(monkeypatch):
     assert two["collectives"]["all-reduce"]["count"] > 0
     assert two["collective_bytes"] > 0
     assert two["img_per_s"] > 0 and two["efficiency"] > 0
+
+
+def test_scan_and_e2e_lines_carry_goodput_breakdown(bench_mod, capsys):
+    """ISSUE 14 acceptance: the scan, LARS, and e2e lines carry the
+    StepLedger breakdown (per-category shares + the attribution
+    verdict), so the synthetic-vs-e2e gap is auto-attributed -- the
+    e2e stub reads input-bound while the synthetic scan reads
+    compute-bound, which IS the r04 1258-vs-2474 attribution."""
+    bench_mod.main()
+    _names, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    for metric, bound in (
+            ("resnet50_imagenet_train_bf16_scan", "compute"),
+            ("resnet50_imagenet_train_bf16_lars_largebatch", "compute"),
+            ("resnet50_imagenet_train_e2e_bf16", "input")):
+        gp = by[metric].get("goodput")
+        assert gp, "%s line missing goodput" % metric
+        for key in ("steps", "wall_s", "shares", "verdict", "bound",
+                    "reconciled", "env_degraded"):
+            assert key in gp, (metric, key)
+        assert gp["bound"] == bound, (metric, gp)
+        assert set(gp["shares"]) == {
+            "device_compute", "input_wait", "host_sync",
+            "checkpoint_stall", "recompile", "other"}
+    e2e = by["resnet50_imagenet_train_e2e_bf16"]["goodput"]
+    assert "feed supplies" in e2e["verdict"]
+
+
+def test_e2e_bench_runs_the_ledger(monkeypatch):
+    """Source contract on the UNPATCHED module: the e2e config measures
+    through the library StepLedger (obs.goodput), not bench-local
+    accounting, and the scan/LARS configs do the same."""
+    import inspect
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    for fn in (bench.bench_resnet50_e2e, bench.bench_resnet50_scan,
+               bench.bench_resnet50_lars):
+        src = inspect.getsource(fn)
+        assert "_goodput_begin" in src and "_goodput_end" in src, \
+            fn.__name__
+    src = inspect.getsource(bench._goodput_begin)
+    assert "StepLedger" in src
+    src = inspect.getsource(bench._goodput_end)
+    assert "line_summary" in src
+
+
+def test_degraded_env_flag_agrees_with_goodput_env_guard(monkeypatch):
+    """ISSUE 14 satellite (contract-locked): the JSONL degraded_env
+    flag and the sentinel's goodput.env_degraded event derive from ONE
+    threshold -- when the env guard trips, both say degraded; when
+    healthy, both say healthy."""
+    import numpy as np  # noqa: F401
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.obs import goodput
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    was = telemetry.enabled()
+    telemetry.enable()
+    monkeypatch.setattr(bench, "_ENV_DEGRADED", {"flag": None})
+    try:
+        telemetry.reset("goodput.")
+        telemetry.reset("env.")
+        # collapsed tunnel: the probe marks the line degraded AND sets
+        # the gauge the sentinel's env guard reads
+        flag = bench._mark_env_health(
+            {"dispatch_roundtrip_us": 90000.0, "h2d_mb_per_s": 1.0})
+        assert flag is True
+        led = goodput.StepLedger(window_steps=2)
+        telemetry.timer("profiling.step_time").observe(0.004)
+        win = led.step(2)
+        assert win["env_degraded"] is flag is True
+        assert telemetry.counter(
+            "goodput.env_degraded_windows").value == 1
+        ev = telemetry.event("goodput.env_degraded").recent[-1]
+        assert ev["dispatch_roundtrip_us"] == 90000.0
+        assert win["regressions"] == []       # env, never regression
+        # healthy probe: both sides flip together
+        flag = bench._mark_env_health(
+            {"dispatch_roundtrip_us": 2.0, "h2d_mb_per_s": 100.0})
+        telemetry.timer("profiling.step_time").observe(0.004)
+        win = led.step(2)
+        assert win["env_degraded"] is flag is False
+        assert telemetry.counter(
+            "goodput.env_degraded_windows").value == 1
+    finally:
+        telemetry.reset("goodput.")
+        telemetry.reset("env.")
+        if not was:
+            telemetry.disable()
 
 
 def test_scan_failure_falls_back_for_headline(bench_mod, capsys,
