@@ -34,6 +34,16 @@
 #            keep serving the previous verified one, zero dropped
 #            requests), and a batcher flood (sheds counted, accepted
 #            requests all complete, tail bounded by the queue depth)
+#   chaos_dist -> distributed resilience gate (docs/chaos.md multi-host
+#                 section, seed 0): a REAL 2-proc supervised run where
+#                 rank 1 is chaos-KILLed between the "written" and
+#                 "committed" barriers of a sharded publish -- the
+#                 survivor must abort with a typed BarrierTimeout
+#                 naming rank 1 within the bound, NO merged manifest
+#                 may exist, the elastic supervisor must relaunch
+#                 generation 1, and both ranks must resume parameters
+#                 BIT-IDENTICAL to the last verified step; plus the
+#                 restart-budget exhaustion path gated NOT_READY
 #   spmd -> one-program multi-host gate (docs/distributed.md): a REAL
 #           2-process gloo smoke train through tools/launch.py -- the
 #           dist train step must be ONE compiled SPMD program whose
@@ -96,7 +106,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos obs bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos chaos_dist obs bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -318,6 +328,7 @@ EOF
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py \
         tests/test_serving.py tests/test_chaos.py tests/test_obs.py \
+        tests/test_resilience.py \
         -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
@@ -764,7 +775,121 @@ print("flood gate ok: %d sheds, %d completed, max latency %.0fms "
       % (rep["shed"], rep["completed"], 1e3 * rep["max_latency_s"],
          1e3 * rep["latency_bound_s"]))
 EOF
+    log "chaos: distributed resilience tests (typed failures, spec replay, supervisor)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+        -m 'not slow'
     rm -rf "$chdir"
+}
+
+run_chaos_dist() {
+    log "chaos_dist: 2-proc kill-mid-sharded-commit -> abort -> supervised relaunch -> bit-identical resume (seed 0)"
+    cdir=$(mktemp -d /tmp/mxtpu_chaos_dist.XXXXXX)
+    cat > "$cdir/worker.py" <<'EOF'
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, telemetry
+from mxnet_tpu import distributed as dist
+from mxnet_tpu.chaos import scenarios
+from mxnet_tpu.serving.loop import ContinuousTrainer
+
+outdir = sys.argv[1]
+assert mx.distributed_init() is True
+nproc, rank = dist.world()
+gen = dist.generation()
+telemetry.enable()
+chaos.arm_from_spec()            # EXPLICIT harness opt-in; the rule is
+                                 # rank-1 + generation-0 scoped
+# identical replicated params on every rank (the SPMD init contract)
+np.random.seed(0)
+mx.random.seed(0)
+net, trainer, loss_fn, data = scenarios.train_fixtures(seed=0)
+ct = ContinuousTrainer(net, trainer, loss_fn, data, outdir + "/ckpts",
+                       publish_every=1)
+ckpt = ct.resume()
+
+def dump_params(tag):
+    arrs = {k: p._reduce().asnumpy() for k, p in
+            net._collect_params_with_prefix().items()}
+    np.savez(outdir + "/%s_rank%d.npz" % (tag, rank), **arrs)
+
+if gen == 0:
+    assert ckpt is None
+    ct.run_steps(1)              # publish step 1 (verified)
+    dump_params("step1")         # the bit-identical reference
+    try:
+        ct.run_steps(2)          # step-2 publish: rank 1 dies between
+                                 # the "written" and "committed" barriers
+    except dist.BarrierTimeout as e:
+        assert 1 in e.ranks, e.ranks
+        assert e.tag == "ckpt_committed", e.tag
+        assert ct.manager.latest_step() == 1, ct.manager.all_steps()
+        assert not os.path.isdir(ct.manager.step_dir(2)), \
+            "merged manifest committed past a dead rank!"
+        assert telemetry.counter("checkpoint.commit_aborted").value == 1
+        print("SURVIVOR_ABORT rank=%d %s: %s" % (
+            rank, type(e).__name__, e), flush=True)
+        dist.failfast_exit(3)    # surface to the supervisor per policy
+    raise SystemExit("chaos kill did not fire (rank %d)" % rank)
+
+assert gen == 1, gen
+assert ckpt is not None and ckpt.step == 1, ckpt
+side = np.load(outdir + "/step1_rank%d.npz" % rank)
+for k, p in sorted(net._collect_params_with_prefix().items()):
+    a = p.data().asnumpy()
+    b = side[k]
+    assert a.dtype == b.dtype and a.tobytes() == b.tobytes(), k
+print("RESUME_BIT_IDENTICAL rank=%d generation=%d step=%d"
+      % (rank, gen, ckpt.step), flush=True)
+ct.run_steps(2)                  # steps 2..3 publish clean
+dist.barrier("gen1_steps_done")  # rename visibility (read-after-save)
+assert ct.manager.latest_step() == 3, ct.manager.all_steps()
+ct.close()
+print("GEN1_DONE rank=%d" % rank, flush=True)
+EOF
+    spec=$(JAX_PLATFORMS=cpu python - <<'EOF'
+from mxnet_tpu import chaos
+print(chaos.make_spec(seed=0, rules=[
+    {"point": "checkpoint.sharded.barrier.committed",
+     "action": "kill", "nth": 2, "rank": 1, "generation": 0}]))
+EOF
+)
+    JAX_PLATFORMS=cpu MXNET_TPU_CHAOS_SPEC="$spec" \
+        MXNET_TPU_DIST_BARRIER_TIMEOUT_MS=8000 \
+        MXNET_TPU_DIST_LEASE_TTL_S=4 \
+        PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python tools/launch.py -n 2 --supervise --max-restarts 2 \
+        --grace 30 python -u "$cdir/worker.py" "$cdir" \
+        | tee "$cdir/out.log"
+    # the gates: typed abort naming the dead rank, one relaunch, and a
+    # bit-identical resume on BOTH ranks of generation 1
+    grep -q "SURVIVOR_ABORT rank=0 BarrierTimeout" "$cdir/out.log"
+    grep -q "rank(s) \[1\]" "$cdir/out.log"
+    grep -q "relaunching generation 1" "$cdir/out.log"
+    grep -q "RESUME_BIT_IDENTICAL rank=0 generation=1 step=1" "$cdir/out.log"
+    grep -q "RESUME_BIT_IDENTICAL rank=1 generation=1 step=1" "$cdir/out.log"
+    [ "$(grep -c GEN1_DONE "$cdir/out.log")" -eq 2 ]
+    log "chaos_dist: restart-budget exhaustion -> /healthz NOT_READY gate"
+    JAX_PLATFORMS=cpu MXNET_TPU_TELEMETRY=1 python - <<'EOF'
+import sys
+from mxnet_tpu import telemetry
+from mxnet_tpu.obs import status
+from mxnet_tpu.supervisor import Supervisor
+
+sup = Supervisor([sys.executable, "-c", "import sys; sys.exit(2)"], 2,
+                 max_restarts=1, grace_s=2)
+rc = sup.run()
+assert rc == 2 and sup.exhausted and sup.restarts == 1, (rc, sup.restarts)
+assert telemetry.counter("supervisor.restarts").value == 1
+assert telemetry.counter("supervisor.budget_exhausted").value == 1
+ready, reasons = status.health()
+assert not ready and "restart_budget_exhausted:1" in reasons, reasons
+print("budget-exhaustion gate ok: NOT_READY reasons =", reasons)
+EOF
+    rm -rf "$cdir"
 }
 
 run_kernels() {

@@ -17,6 +17,10 @@ package is the weather machine:
   CI's ``chaos`` stage, and ``bench_serving_hotswap`` share --
   continuous-train -> hot-swap under client load (with an optional
   torn publish), and a flood past the bounded serving queue;
+- **cross-process replay** (ISSUE 15): ``make_spec()`` serializes a
+  seeded scenario into ``MXNET_TPU_CHAOS_SPEC`` and launched ranks
+  replay it with the EXPLICIT ``arm_from_spec()`` call (rules scoped
+  per rank and per supervisor generation; production stays env-inert);
 - **accounting**: every injected fault counts
   (``chaos.injected.<point>``) and every tolerated one -- injected or
   real -- is recorded by the recovery path itself
@@ -28,13 +32,14 @@ Fail-point catalogue, seeding rules, and how to add a point:
 """
 from __future__ import annotations
 
-from .core import (KILL, RAISE, ChaosInjected, arm, armed, disarm,
-                   fail_point, on, reset, scenario, sleep, stats,
-                   survived, truncate)
+from .core import (KILL, RAISE, ChaosInjected, arm, arm_from_spec,
+                   armed, disarm, fail_point, make_spec, on, reset,
+                   scenario, sleep, stats, survived, truncate)
 
 __all__ = [
     "ChaosInjected", "arm", "disarm", "armed", "reset", "on",
     "fail_point", "survived", "stats", "scenario",
+    "arm_from_spec", "make_spec",
     "RAISE", "KILL", "sleep", "truncate",
     "scenarios",
 ]

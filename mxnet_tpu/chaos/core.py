@@ -42,6 +42,7 @@ can assert without telemetry armed.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import random
 import time
@@ -53,6 +54,7 @@ from ..base import MXNetError
 __all__ = [
     "ChaosInjected", "arm", "disarm", "armed", "reset", "on",
     "fail_point", "survived", "stats", "scenario",
+    "arm_from_spec", "make_spec",
     "RAISE", "KILL", "sleep", "truncate",
 ]
 
@@ -66,7 +68,13 @@ KILL = "kill"
 
 
 class ChaosInjected(MXNetError):
-    """The fault a ``chaos.RAISE`` rule injects at a fail point."""
+    """The fault a ``chaos.RAISE`` rule injects at a fail point
+    (``point`` names it, so recovery paths can pair their survival
+    count with the exact site that made the weather)."""
+
+    def __init__(self, msg, point=None):
+        super().__init__(msg)
+        self.point = point
 
 
 def sleep(seconds):
@@ -219,7 +227,7 @@ def _visit(name, ctx):
     action = fire.action
     if action == RAISE:
         raise ChaosInjected("chaos: injected fault at %r (hit %d)"
-                            % (name, fire.hits))
+                            % (name, fire.hits), point=name)
     if action == KILL:
         # last act before the SIGKILL-shaped death: mark the flight
         # recorder (injected point + in-flight trace) and msync -- the
@@ -262,3 +270,91 @@ def scenario(seed=0):
         yield
     finally:
         disarm()
+
+
+# ----------------------------------------------------------------------
+# Cross-process chaos (ISSUE 15): a scenario serialized for launched
+# ranks.  The launcher (a test, CI's chaos_dist stage) builds a spec
+# with make_spec() and ships it in MXNET_TPU_CHAOS_SPEC; each worker
+# replays it with arm_from_spec() -- an EXPLICIT harness call, so a
+# production process with the variable in its environment stays inert
+# (the same env-inert contract as arm()).  Rules can be scoped to one
+# launcher rank and one supervisor generation, so "KILL rank 1 between
+# the written and committed barriers, generation 0 only" is one JSON
+# line replayed identically by every rank of every relaunch.
+# ----------------------------------------------------------------------
+
+def make_spec(seed=0, rules=()):
+    """Serialize a chaos scenario for cross-process replay.  Each rule
+    is a dict: ``point`` (required), ``action`` (``"raise"`` (default),
+    ``"kill"``, ``{"sleep": seconds}``, or ``{"truncate": {"fname": f,
+    "keep": n}}``), ``nth``/``prob``/``times`` as in :func:`on`, plus
+    ``rank`` / ``generation`` scoping (omit = every rank / every
+    generation)."""
+    spec = {"seed": seed, "rules": [dict(r) for r in rules]}
+    for rule in spec["rules"]:
+        _spec_action(rule.get("action", RAISE))   # validate early
+        if "point" not in rule:
+            raise MXNetError("chaos spec rule without a point: %r"
+                             % (rule,))
+    return json.dumps(spec, sort_keys=True)
+
+
+def _spec_action(action):
+    """Deserialize one spec action into what :func:`on` takes."""
+    if action in (RAISE, KILL):
+        return action
+    if isinstance(action, dict) and len(action) == 1:
+        if "sleep" in action:
+            return sleep(float(action["sleep"]))
+        if "truncate" in action:
+            t = action["truncate"]
+            if isinstance(t, str):
+                return truncate(t)
+            return truncate(t["fname"], keep=int(t.get("keep", 8)))
+    raise MXNetError("chaos spec: unknown action %r (want 'raise', "
+                     "'kill', {'sleep': s} or {'truncate': ...})"
+                     % (action,))
+
+
+def arm_from_spec(spec=None, rank=None, generation=None):
+    """Arm this process from a serialized rule spec -- the multi-rank
+    test harness's EXPLICIT opt-in.  ``spec`` defaults to the
+    ``MXNET_TPU_CHAOS_SPEC`` environment variable; absent/empty returns
+    False without arming anything.  ``rank`` defaults to
+    ``MXNET_TPU_PROC_ID`` and ``generation`` to
+    ``MXNET_TPU_GENERATION``; rules scoped to another rank/generation
+    are skipped, so one spec drives a whole launched world across
+    supervisor relaunches.  Clears previous rules, then arms with the
+    spec's seed (rules replay deterministically per rank)."""
+    if spec is None:
+        spec = os.environ.get("MXNET_TPU_CHAOS_SPEC", "")
+    if isinstance(spec, (bytes, str)):
+        if not spec.strip():
+            return False
+        spec = json.loads(spec)
+    if rank is None:
+        rank = _env_int("MXNET_TPU_PROC_ID")
+    if generation is None:
+        generation = _env_int("MXNET_TPU_GENERATION")
+    reset()
+    arm(spec.get("seed", 0))
+    for rule in spec.get("rules", ()):
+        if rule.get("rank") is not None and int(rule["rank"]) != rank:
+            continue
+        if rule.get("generation") is not None \
+                and int(rule["generation"]) != generation:
+            continue
+        nth = rule.get("nth")
+        if isinstance(nth, list):
+            nth = tuple(nth)
+        on(rule["point"], action=_spec_action(rule.get("action", RAISE)),
+           nth=nth, prob=rule.get("prob"), times=rule.get("times"))
+    return True
+
+
+def _env_int(name):
+    try:
+        return int(os.environ.get(name, "0") or 0)
+    except ValueError:
+        return 0

@@ -355,6 +355,12 @@ class CheckpointManager:
             self._writer = AsyncWriter()
         os.makedirs(self.root, exist_ok=True)
         sweep_stale_tmps(self.root)
+        if _topology()["process_id"] == 0:
+            # a dead multi-rank world's shared staging (ISSUE 15):
+            # the sharded layout stages without a pid suffix, so its
+            # sweep rides an owner marker instead (sharded.py)
+            from . import sharded as _sharded
+            _sharded.sweep_shared_staging(self.root)
 
     # -- layout --------------------------------------------------------
     def step_dir(self, step):

@@ -1,4 +1,5 @@
-"""Multi-process sharded checkpoints (ISSUE 3 layer 3).
+"""Multi-process sharded checkpoints (ISSUE 3 layer 3; rank-death-safe
+commit protocol since ISSUE 15).
 
 Under a multi-process ``parallel/mesh.py`` run a parameter is ONE
 global ``jax.Array`` whose shards live across hosts; no single process
@@ -8,34 +9,64 @@ can (or should) serialize it alone.  The layout here:
   only the ``replica_id == 0`` copies, so replicated axes are stored
   once -- into ``<item>.shard<rank>.params`` plus a
   ``<item>.shard<rank>.json`` index mapping each stored entry to its
-  ``(key, global_shape, dtype, slices)``;
-- all processes rendezvous (``kvstore.barrier()`` semantics --
-  ``distributed.barrier``), then **process 0 alone** digests every
-  staged file and commits the merged manifest + directory rename, so
-  the commit point stays a single atomic ``os.replace``;
+  ``(key, global_shape, dtype, slices)``; each file lands through a
+  pid-suffixed temp + rename, so a killed rank leaves ``*.tmp`` crumbs
+  (swept by the next save), never a plausible-looking partial shard;
+- all processes rendezvous at three **attributed barriers**
+  (``distributed.barrier`` -- a timeout raises a typed
+  ``BarrierTimeout`` naming the missing rank, never a raw jaxlib
+  deadline): ``stage`` after the staging dir exists, ``written`` after
+  every rank's shards are durable, and ``committed`` -- the commit
+  GATE: **process 0 stages the merged manifest, then the whole world
+  confirms at "committed" BEFORE the atomic directory rename**.  A
+  rank dead anywhere up to that gate means the rename never happens --
+  the PR-3 manifest-last invariant extended across ranks: a torn step
+  is impossible, a rank death costs at most one step.  (The rename
+  happens *after* the gate, so on ranks != 0 a returned save precedes
+  global visibility by an instant -- a reader that needs the step
+  visible right after ``save`` rendezvouses first, e.g.
+  ``distributed.barrier("published")``);
+- a failed save aborts *cleanly* on every survivor: the staging dir is
+  swept, ``checkpoint.commit_aborted`` counts it, a failing-but-alive
+  rank posts an abort ack (``distributed.post_abort``) so peers fail
+  fast instead of waiting out the barrier bound, and the typed error
+  propagates for the caller's policy (continue past the failed publish
+  or surface to the restart supervisor -- ``serving.loop``);
 - restore reads *all* shard files, reassembles each parameter into its
   global array, and places it onto the **current** mesh via the
   caller's ``sharding`` -- the saved topology is recorded in the
   manifest but never required to match, so a job preempted on one
   topology can resume on another.
 
+Chaos fail points (docs/chaos.md) cover every dangerous spot: each
+barrier (``checkpoint.sharded.barrier.<tag>``), the per-rank shard
+write (``checkpoint.sharded.shard_write``), and the merged-manifest
+commit (``checkpoint.sharded.commit``).
+
 Single-process runs degrade cleanly (every shard is addressable,
-rank 0 is the only writer); the machinery is identical, which is what
-the test suite exercises on 8 virtual CPU devices.
+rank 0 is the only writer, barriers are no-ops); the machinery is
+identical, which is what the test suite exercises on 8 virtual CPU
+devices.
 """
 from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 
 import numpy as np
 
 import jax
 
+from .. import chaos as _chaos
+from .. import telemetry as _telemetry
 from . import core as _core
 
-__all__ = ["save_sharded", "restore_sharded"]
+__all__ = ["save_sharded", "restore_sharded", "sweep_shared_staging"]
+
+_SHARED_STAGING_RE = re.compile(r"^step_\d{8}\.shared\.tmp$")
+_OWNER_PREFIX = ".owner."
 
 
 def _world():
@@ -46,10 +77,54 @@ def _world():
         return 1, 0
 
 
-def _barrier(nprocs, tag):
+def _barrier(nprocs, tag, step=None):
     if nprocs > 1:
         from ..distributed import barrier
+        # chaos: a KILL here is a rank dying AT the rendezvous -- the
+        # previous phase's work done, the ack never posted; survivors
+        # must abort with a typed BarrierTimeout naming this rank
+        _chaos.fail_point("checkpoint.sharded.barrier." + tag,
+                          tag=tag, step=step)
         barrier("ckpt_%s" % tag)
+
+
+def sweep_shared_staging(root):
+    """Remove ``step_<N>.shared.tmp`` staging dirs left by a dead
+    sharded save -- the multi-rank analog of ``core.sweep_stale_tmps``.
+    The shared staging name carries no pid (all ranks address one
+    dir), so liveness rides the ``.owner.<pid>`` marker rank 0 drops
+    at creation: a dir whose owner is dead -- or that has no marker at
+    all -- is torn down; a live owner's dir is in flight and left
+    alone (but its *interior* dead-pid ``*.tmp`` shard crumbs, a
+    killed rank's partial write, are swept).  Returns removed paths.
+    """
+    removed = []
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return removed
+    for name in entries:
+        if not _SHARED_STAGING_RE.match(name):
+            continue
+        path = os.path.join(root, name)
+        if not os.path.isdir(path):
+            continue
+        owner = None
+        try:
+            for inner in os.listdir(path):
+                if inner.startswith(_OWNER_PREFIX):
+                    owner = int(inner[len(_OWNER_PREFIX):])
+                    break
+        except (OSError, ValueError):
+            pass
+        if owner is not None and (owner == os.getpid()
+                                  or _core._pid_alive(owner)):
+            removed.extend(_core.sweep_stale_tmps(path))
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+        _chaos.survived("checkpoint.sharded.shard_write", "sweep")
+    return removed
 
 
 def _index_of(shard, shape):
@@ -87,29 +162,59 @@ def save_sharded(manager, step, items, metadata):
     bytes written *by this process* (manifest totals cover all ranks).
 
     The staging directory name is deterministic (no pid suffix) so all
-    ranks address the same dir; rank 0 creates and commits it.
+    ranks address the same dir; rank 0 creates and commits it.  Any
+    failure -- a peer dead at a barrier, a local write error, an
+    injected fault -- aborts the whole save cleanly (see
+    :func:`_abort_save`); the manifest is only ever renamed into place
+    after EVERY rank confirmed at the "committed" gate.
     """
-    from .. import ndarray as nd
+    from ..distributed import RankFailure
     nprocs, rank = _world()
     final = manager.step_dir(step)
     staging = final + ".shared.tmp"
+    # the gate every survivor re-raises through; "stage" until the
+    # first barrier passes, None once the commit gate has been crossed
+    pending_gate = ["stage"]
+    try:
+        return _save_sharded_inner(manager, step, items, metadata,
+                                   nprocs, rank, final, staging,
+                                   pending_gate)
+    except BaseException as e:
+        if isinstance(e, Exception):
+            _abort_save(e, step, staging, nprocs, rank, pending_gate[0],
+                        RankFailure)
+        raise
+
+
+def _save_sharded_inner(manager, step, items, metadata, nprocs, rank,
+                        final, staging, pending_gate):
+    from .. import ndarray as nd
     if rank == 0:
+        # dead predecessors first (a killed world's staging, ISSUE 15
+        # satellite), then this step's own leftover
+        sweep_shared_staging(manager.root)
         if os.path.isdir(staging):
             shutil.rmtree(staging)
         os.makedirs(staging)
-    _barrier(nprocs, "stage")
+        with open(os.path.join(staging,
+                               _OWNER_PREFIX + str(os.getpid())),
+                  "w"):
+            pass
+    _barrier(nprocs, "stage", step)
+    pending_gate[0] = "written"
 
     nd.waitall()
     written = 0
     for name, value in sorted(items.items()):
+        # chaos: a KILL here is a rank dying mid-shard-write --
+        # pid-tmp crumbs on disk, no "written" ack; survivors abort at
+        # the next barrier and the crumbs are swept by the next save
+        _chaos.fail_point("checkpoint.sharded.shard_write", item=name,
+                          rank=rank, step=step, path=staging)
         if isinstance(value, (bytes, bytearray, memoryview)):
             if rank == 0:               # opaque blobs are rank-0 state
-                fname = name + ".bin"
-                # staging dir: atomicity comes from the directory
-                # rename at commit, not per-file temps
-                with open(os.path.join(staging, fname), "wb") as f:  # mxlint: disable=bare-state-write
-                    f.write(bytes(value))
-                written += len(value)
+                written += _stage_file(staging, name + ".bin",
+                                       lambda p: _write_bytes(p, value))
             continue
         payload = {}
         index = {}
@@ -124,18 +229,20 @@ def save_sharded(manager, step, items, metadata):
                 entry["slices"].append({"key": skey, "index": sl})
             index[key] = entry
         fname = "%s.shard%05d.params" % (name, rank)
-        nd.save(os.path.join(staging, fname), payload)
-        with open(os.path.join(staging, fname[:-7] + ".json"), "w") as f:
-            json.dump({"item": name, "rank": rank, "params": index}, f)
-        for suffix in (fname, fname[:-7] + ".json"):
-            nbytes, _ = _core._fsync_and_digest(
-                os.path.join(staging, suffix))
-            written += nbytes
+        written += _stage_file(staging, fname,
+                               lambda p: nd.save(p, payload))
+        written += _stage_file(
+            staging, fname[:-7] + ".json",
+            lambda p: _write_json(p, {"item": name, "rank": rank,
+                                      "params": index}))
 
-    _barrier(nprocs, "written")
+    _barrier(nprocs, "written", step)
+    pending_gate[0] = "committed"
     if rank == 0:
         files = {}
         for fname in sorted(os.listdir(staging)):
+            if fname.startswith("."):
+                continue                # the .owner.<pid> marker
             nbytes, crc = _core.file_digest(os.path.join(staging, fname))
             kind = "shard" if ".shard" in fname else "bin"
             item = fname.split(".shard")[0] if kind == "shard" \
@@ -157,15 +264,88 @@ def save_sharded(manager, step, items, metadata):
                 json.dump(manifest, f, indent=1, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
+        # chaos: a KILL here is the coordinator dying mid-merge --
+        # every shard durable, no manifest; survivors time out at the
+        # "committed" gate naming rank 0 and the save costs one step
+        _chaos.fail_point("checkpoint.sharded.commit", step=step,
+                          path=staging)
         _core.commit(os.path.join(staging, _core.MANIFEST_NAME),
                      _write_manifest)
         _core._fsync_dir(staging)
+    # the commit GATE (cross-rank manifest-last invariant): the staged
+    # manifest becomes visible ONLY after every rank confirms it got
+    # this far -- a rank dead between "written" and here leaves the
+    # manifest staged in a *.shared.tmp dir discovery never reads, so
+    # the torn step is impossible and latest_step() falls back one step
+    _barrier(nprocs, "committed", step)
+    pending_gate[0] = None
+    if rank == 0:
+        try:
+            os.remove(os.path.join(staging,
+                                   _OWNER_PREFIX + str(os.getpid())))
+        except OSError:
+            pass
         if os.path.isdir(final):
             shutil.rmtree(final)
         os.replace(staging, final)
         _core._fsync_dir(manager.root)
-    _barrier(nprocs, "committed")
     return written
+
+
+def _stage_file(staging, fname, write_fn):
+    """Write one staged file through a pid-suffixed temp + fsync +
+    rename, so a rank killed mid-write leaves only an obvious ``*.tmp``
+    crumb (swept by :func:`sweep_shared_staging`), never a torn file
+    under a final name.  Returns the bytes written."""
+    tmp = os.path.join(staging, "%s.%d.tmp" % (fname, os.getpid()))
+    write_fn(tmp)
+    nbytes, _crc = _core._fsync_and_digest(tmp)
+    os.replace(tmp, os.path.join(staging, fname))
+    return nbytes
+
+
+def _write_bytes(path, value):
+    # staging dir: atomicity comes from the pid-tmp rename in
+    # _stage_file plus the directory rename at commit
+    with open(path, "wb") as f:  # mxlint: disable=bare-state-write
+        f.write(bytes(value))
+
+
+def _write_json(path, obj):
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+def _abort_save(exc, step, staging, nprocs, rank, gate, rank_failure):
+    """Clean abort on every survivor: tell peers (a failing-but-alive
+    rank posts an abort ack at the gate they will wait on next, so
+    they fail fast instead of timing out), sweep the staging dir, and
+    count ``checkpoint.commit_aborted`` -- the caller re-raises the
+    typed error for its publish policy."""
+    if gate is not None and nprocs > 1 \
+            and not isinstance(exc, rank_failure):
+        # a local failure (write error, injected RAISE): peers are
+        # healthy and heading for the next barrier -- abort it
+        from ..distributed import post_abort
+        try:
+            post_abort("ckpt_%s" % gate, reason=type(exc).__name__)
+        except Exception:
+            pass
+    shutil.rmtree(staging, ignore_errors=True)
+    if _telemetry._ENABLED:
+        _telemetry.hooks.checkpoint_commit_aborted(
+            step, "%s: %s" % (type(exc).__name__, exc), rank=rank)
+    # survival accounting: the abort path IS the recovery -- pair the
+    # survived count with the fail point that made the weather
+    if isinstance(exc, rank_failure):
+        tag = getattr(exc, "tag", "") or ""
+        point = "checkpoint.sharded.barrier." + tag[5:] \
+            if tag.startswith("ckpt_") else "checkpoint.sharded.commit"
+    elif getattr(exc, "point", None):     # an injected local fault
+        point = exc.point
+    else:
+        point = "checkpoint.sharded.commit"
+    _chaos.survived(point, "abort")
 
 
 def restore_sharded(dirpath, manifest, sharding=None):

@@ -364,6 +364,54 @@ _VARS = [
            "absolute deviations (and the move is at least 5% of the "
            "window wall).  Per-ledger override: "
            "StepLedger(mad_k=...)."),
+    EnvVar("MXNET_TPU_CHAOS_SPEC", str, "",
+           "Serialized chaos scenario (chaos.make_spec() JSON: seed + "
+           "rules with per-rank/per-generation scoping) for launched "
+           "multi-process test harnesses.  NEVER arms anything by "
+           "itself: a worker replays it only by explicitly calling "
+           "chaos.arm_from_spec(), so production processes stay inert "
+           "with the variable present (the env-inert contract of "
+           "chaos.arm())."),
+    EnvVar("MXNET_TPU_GENERATION", int, 0,
+           "Supervisor generation id of this worker world, bumped by "
+           "the elastic restart supervisor (tools/launch.py "
+           "--supervise) on every relaunch.  Namespaces every "
+           "coordination-KV key (barriers, collectives, liveness "
+           "leases), and the new generation's first rendezvous sweeps "
+           "the previous generation's keys."),
+    EnvVar("MXNET_TPU_DIST_BARRIER_TIMEOUT_MS", int, 60000,
+           "Default bound on every attributed barrier rendezvous "
+           "(distributed.barrier and the sharded-checkpoint commit "
+           "gates).  On expiry survivors raise a typed BarrierTimeout "
+           "naming the missing rank(s) -- never a raw jaxlib "
+           "DEADLINE_EXCEEDED.  Per-call override: "
+           "barrier(timeout_ms=...)."),
+    EnvVar("MXNET_TPU_DIST_LEASE_TTL_S", float, 10.0,
+           "Liveness-lease staleness bound: a rank whose "
+           "mxlive/g<gen>/<rank> coordination key is older than this "
+           "(or absent) is reported 'presumed dead' in "
+           "BarrierTimeout/RankFailure attribution.  The training "
+           "loop beats the lease every step; every barrier entry "
+           "refreshes it too."),
+    EnvVar("MXNET_TPU_DIST_KV_RETRIES", int, 2,
+           "Bounded retries (doubling backoff from 50 ms) for "
+           "TRANSIENT coordination-KV errors in host collectives and "
+           "barriers.  Deadline expiries are not transient -- they "
+           "attribute a missing peer and raise typed errors "
+           "immediately.  0 disables retries."),
+    EnvVar("MXNET_TPU_SUPERVISOR_RESTARTS", int, 3,
+           "Elastic-restart budget: how many times the supervisor "
+           "(tools/launch.py --supervise / mxnet_tpu.supervisor) "
+           "relaunches the world after a rank death before going "
+           "terminal (supervisor.exhausted event, /healthz NOT_READY)."
+           "  Per-supervisor override: Supervisor(max_restarts=...)."),
+    EnvVar("MXNET_TPU_SUPERVISOR_GRACE_S", float, 15.0,
+           "After the first rank exit of a generation, how long the "
+           "supervisor waits for the survivors to notice (typed "
+           "BarrierTimeout) and exit on their own before killing the "
+           "process tree.  Set it above "
+           "MXNET_TPU_DIST_BARRIER_TIMEOUT_MS so survivor logs carry "
+           "the attributed error."),
     EnvVar("MXNET_TPU_EAGER_BULK_MAX", int, 512,
            "Capacity flush threshold for the bulked eager queue: a "
            "pending region is flushed once it reaches this many ops, "

@@ -11,7 +11,10 @@ pod manager needs it:
 - a failed async checkpoint write (``checkpoint.write_failures``) means
   published state is behind training -- NOT_READY;
 - a servable whose bounded queue sits at capacity is shedding load --
-  NOT_READY (scale out / back off).
+  NOT_READY (scale out / back off);
+- an elastic restart supervisor whose generation is down (a rank died
+  and the relaunch has not landed) or whose restart budget is spent --
+  NOT_READY until the world is back or an operator intervenes.
 
 ``/statusz`` adds the operator narrative: served vs published step,
 recent swap history (the ``serving.swap`` event ring), bucket
@@ -26,12 +29,14 @@ import time
 import weakref
 
 __all__ = ["register_watcher", "register_registry", "register_trainer",
-           "register_ledger", "heartbeat", "health", "statusz", "reset"]
+           "register_ledger", "register_supervisor", "heartbeat",
+           "health", "statusz", "reset"]
 
 _watchers = weakref.WeakSet()
 _registries = weakref.WeakSet()
 _trainers = weakref.WeakSet()
 _ledgers = weakref.WeakSet()    # goodput StepLedgers (obs.goodput)
+_supervisors = weakref.WeakSet()   # elastic restart supervisors
 _heartbeats = {}                # rank -> wall time of last beat
 
 
@@ -58,6 +63,10 @@ def register_ledger(ledger):
     _ledgers.add(ledger)
 
 
+def register_supervisor(supervisor):
+    _supervisors.add(supervisor)
+
+
 def heartbeat(rank=None):
     """One liveness beat (the trainer loop calls this every step)."""
     _heartbeats[_rank() if rank is None else int(rank)] = time.time()
@@ -69,6 +78,7 @@ def reset():
     _registries.clear()
     _trainers.clear()
     _ledgers.clear()
+    _supervisors.clear()
     _heartbeats.clear()
 
 
@@ -90,6 +100,15 @@ def health():
     failures = _counter_value("checkpoint.write_failures")
     if failures:
         reasons.append("checkpoint_write_failures:%d" % failures)
+    for s in list(_supervisors):
+        try:
+            if s.exhausted:
+                reasons.append("restart_budget_exhausted:%d"
+                               % s.generation)
+            elif s.generation_down:
+                reasons.append("generation_down:%d" % s.generation)
+        except Exception:
+            continue
     for reg in list(_registries):
         try:
             names = reg.names()
@@ -140,6 +159,15 @@ def statusz():
                                   "buckets": list(s.buckets)})
             except Exception:
                 continue
+    supervisors = []
+    for s in list(_supervisors):
+        try:
+            supervisors.append({"generation": s.generation,
+                                "restarts": s.restarts,
+                                "down": s.generation_down,
+                                "exhausted": s.exhausted})
+        except Exception:
+            continue
     goodput = None
     for led in list(_ledgers):
         try:
@@ -165,6 +193,7 @@ def statusz():
         "watchers": watchers,
         "trainers": trainers,
         "servables": servables,
+        "supervisors": supervisors,
         "swap_history": swap_ev.recent if swap_ev is not None else [],
         "bucket_occupancy": (occupancy.snapshot()
                              if occupancy is not None else None),

@@ -65,10 +65,21 @@ class ContinuousTrainer:
     ``CheckpointManager.save_training`` -- atomic commit, manifest
     last -- so the watcher can never observe a half-written step as
     loadable.
+
+    Multi-process runs (ISSUE 15): the loop beats this rank's liveness
+    lease every step (``distributed.beat_lease`` -- what barrier
+    attribution reads to call a missing rank *presumed dead*;
+    single-process pays one attribute check, nothing else), and
+    ``on_publish_error`` sets the policy when a sharded publish aborts
+    on a rank failure: ``"raise"`` (default) surfaces the typed error
+    -- the exit the elastic restart supervisor restarts the world on
+    -- while ``"continue"`` warns and trains past the failed publish
+    (the abort already swept its staging and counted
+    ``checkpoint.commit_aborted``).
     """
 
     def __init__(self, block, trainer, loss_fn, data, manager,
-                 publish_every=1, handler=None):
+                 publish_every=1, handler=None, on_publish_error="raise"):
         self.block = block
         self.trainer = trainer
         self.loss_fn = loss_fn
@@ -78,6 +89,13 @@ class ContinuousTrainer:
         if self.publish_every < 1:
             raise MXNetError("ContinuousTrainer: publish_every must be "
                              ">= 1, got %r" % publish_every)
+        if on_publish_error not in ("raise", "continue"):
+            raise MXNetError("ContinuousTrainer: on_publish_error must "
+                             "be 'raise' or 'continue', got %r"
+                             % (on_publish_error,))
+        self._on_publish_error = on_publish_error
+        from ..distributed import lease_beater
+        self._lease_beat = lease_beater()   # None single-process
         self.handler = handler
         self._lock = _sync.Lock(name="serving.train_loop")
         self._stop = _sync.Event(name="serving.train_loop.stop")
@@ -148,6 +166,10 @@ class ContinuousTrainer:
             # liveness beat for /statusz: a stale heartbeat means a
             # wedged loop even when every thread is technically alive
             _obs.status.heartbeat()
+            if self._lease_beat is not None:
+                # the cross-process twin: the coordination-KV lease
+                # barrier attribution reads to presume a rank dead
+                self._lease_beat()
         return last
 
     def publish(self):
@@ -161,6 +183,19 @@ class ContinuousTrainer:
         try:
             self.manager.save_training(step, self.block, self.trainer,
                                        metadata={"step": step})
+        except Exception as e:
+            from ..distributed import RankFailure
+            if self._on_publish_error == "continue" \
+                    and isinstance(e, RankFailure):
+                # the abort already swept its staging and counted
+                # checkpoint.commit_aborted; the previous published
+                # step keeps serving and training goes on
+                warnings.warn(
+                    "publish of step %d aborted on a rank failure "
+                    "(%s); continuing past it" % (step, e),
+                    RuntimeWarning, stacklevel=2)
+                return None
+            raise
         finally:
             if _sp is not None:
                 _obs.end_span(_sp)

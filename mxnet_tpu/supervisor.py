@@ -1,0 +1,241 @@
+"""Elastic restart supervisor for multi-process training (ISSUE 15).
+
+``tools/launch.py --supervise`` (or :class:`Supervisor` directly)
+watches the worker ranks of one *generation*.  On a TPU pod the common
+failure is preemption of ONE host -- and before this module that meant
+every survivor hung inside a collective, died on an unattributed
+error, and the whole job was lost.  The supervised contract:
+
+1. a rank exits nonzero (or is killed) -> the survivors notice on
+   their own (typed ``BarrierTimeout``/``RankFailure`` from
+   ``distributed.py``, within the barrier bound) and exit; the
+   supervisor grants them ``grace_s`` to do so, then tears the process
+   tree down;
+2. the world's coordination-KV residue is generation-namespaced
+   (``MXNET_TPU_GENERATION``): the supervisor bumps the generation and
+   the NEW world's first rendezvous sweeps the dead generation's keys
+   (``distributed._sweep_previous_generation``); a dead world's shared
+   checkpoint staging is swept by ``CheckpointManager`` init;
+3. the supervisor relaunches every rank with a fresh coordinator port
+   and the bumped generation; workers resume from the newest intact
+   step (``ContinuousTrainer.resume()`` -- the crash-restart contract
+   the CI ``chaos_dist`` gate proves bit-identical);
+4. a bounded restart budget (``MXNET_TPU_SUPERVISOR_RESTARTS``) keeps
+   a persistent failure from flapping forever: exhaustion is terminal
+   (``supervisor.exhausted`` event) and ``/healthz`` reads NOT_READY
+   while a generation is down or the budget is spent
+   (``obs.status.register_supervisor``).
+
+Telemetry: ``supervisor.restarts`` / ``supervisor.generation`` /
+``supervisor.restart`` / ``supervisor.exhausted`` (catalogued in
+``telemetry/hooks.py::INSTRUMENTS``).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from . import chaos as _chaos
+from . import obs as _obs
+from . import telemetry as _telemetry
+from .base import MXNetError
+
+__all__ = ["Supervisor"]
+
+_print_lock = threading.Lock()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _relay(pipe, prefix):
+    """Line-buffered prefixed relay (the launcher behavior): each
+    worker line is ONE atomic write, so generations and ranks never
+    interleave mid-line."""
+    out = sys.stdout.buffer
+    with pipe:
+        for line in iter(pipe.readline, b""):
+            if not line.endswith(b"\n"):
+                line += b"\n"
+            with _print_lock:
+                out.write(prefix + line)
+                out.flush()
+
+
+class Supervisor:
+    """Launch ``num_workers`` ranks of ``command`` and keep the world
+    alive across rank deaths under a bounded restart budget.
+
+    ::
+
+        sup = Supervisor([sys.executable, "-u", "train.py"], 4)
+        rc = sup.run()          # 0 = every rank of some generation
+                                # finished clean
+
+    ``None`` options defer to the env registry
+    (``MXNET_TPU_SUPERVISOR_RESTARTS`` / ``_GRACE_S``); the starting
+    generation comes from ``MXNET_TPU_GENERATION`` so a supervisor
+    itself restarted by a higher-level manager continues the
+    numbering.
+    """
+
+    def __init__(self, command, num_workers, max_restarts=None,
+                 grace_s=None, env=None):
+        from . import env as _env
+        if num_workers < 1:
+            raise MXNetError("Supervisor: num_workers must be >= 1")
+        self.command = list(command)
+        self.num_workers = int(num_workers)
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else _env.get("MXNET_TPU_SUPERVISOR_RESTARTS"))
+        self.grace_s = float(grace_s if grace_s is not None
+                             else _env.get("MXNET_TPU_SUPERVISOR_GRACE_S"))
+        self._base_env = dict(os.environ if env is None else env)
+        self.generation = int(
+            self._base_env.get("MXNET_TPU_GENERATION", "0") or 0)
+        self.restarts = 0
+        self.exhausted = False
+        self._down = False
+        self._procs = []
+        _obs.status.register_supervisor(self)   # weak: /healthz
+
+    # -- state ----------------------------------------------------------
+    @property
+    def generation_down(self):
+        """True between a rank death and the next successful launch --
+        and forever once the restart budget is exhausted.  /healthz
+        reads NOT_READY off this."""
+        return self._down or self.exhausted
+
+    # -- lifecycle ------------------------------------------------------
+    def run(self):
+        """Supervise until a generation finishes clean (returns 0) or
+        the restart budget is exhausted (returns the last failing
+        rank's exit code)."""
+        while True:
+            rc, rank = self._run_generation(self.generation)
+            if rc == 0:
+                self._down = False
+                return 0
+            self._down = True
+            if self.restarts >= self.max_restarts:
+                self.exhausted = True
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.supervisor_exhausted(
+                        self.generation, self.max_restarts)
+                self._log("restart budget (%d) exhausted; generation "
+                          "%d stays down (rank %s exit %d)"
+                          % (self.max_restarts, self.generation,
+                             rank, rc))
+                return rc
+            self.restarts += 1
+            self.generation += 1
+            if _telemetry._ENABLED:
+                _telemetry.hooks.supervisor_restart(
+                    self.generation, rank, rc, self.restarts)
+            # the relaunch IS the recovery path for a rank death
+            _chaos.survived("supervisor.rank_exit", "relaunch")
+            self._log("rank %s exited %d; relaunching generation %d "
+                      "(restart %d/%d)"
+                      % (rank, rc, self.generation, self.restarts,
+                         self.max_restarts))
+
+    def _log(self, msg):
+        with _print_lock:
+            print("supervisor: " + msg, flush=True)
+
+    def _spawn(self, gen, rank, coord):
+        env = dict(self._base_env)
+        env.update({
+            "MXNET_TPU_COORDINATOR": coord,
+            "MXNET_TPU_NUM_PROCS": str(self.num_workers),
+            "MXNET_TPU_PROC_ID": str(rank),
+            "MXNET_TPU_GENERATION": str(gen),
+        })
+        p = subprocess.Popen(self.command, env=env,
+                             start_new_session=True,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        t = threading.Thread(target=_relay,
+                             args=(p.stdout, b"[g%d.%d] " % (gen, rank)),
+                             daemon=True)
+        t.start()
+        p._relay_thread = t
+        return p
+
+    def _run_generation(self, gen):
+        """One generation: fresh coordinator port, all ranks launched
+        with the generation env.  Returns ``(0, None)`` when every
+        rank exits clean, else ``(rc, rank)`` of the first failure
+        (survivors get ``grace_s`` to exit on their own -- long enough
+        for their typed BarrierTimeout -- then the tree is killed)."""
+        coord = "127.0.0.1:%d" % _free_port()
+        self._procs = [self._spawn(gen, rank, coord)
+                       for rank in range(self.num_workers)]
+        self._down = False
+        procs = list(self._procs)
+        first_rc, first_rank = None, None
+        deadline = None
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                t = getattr(p, "_relay_thread", None)
+                if t is not None:
+                    t.join(timeout=10)
+                if rc != 0 and first_rc is None:
+                    first_rc = rc
+                    first_rank = self._procs.index(p)
+                    deadline = time.monotonic() + self.grace_s
+            if not procs:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                self._log("grace (%.0fs) over; killing %d straggler(s) "
+                          "of generation %d"
+                          % (self.grace_s, len(procs), gen))
+                self._kill_tree(procs)
+                break
+            # fail-fast over N children needs a poll round-robin (same
+            # rationale as tools/launch.py): a blocking wait on one
+            # child hides a sibling's death behind it
+            time.sleep(0.1)  # mxlint: disable=sleep-poll
+        if first_rc is None:
+            return 0, None
+        self._kill_tree([p for p in self._procs if p.poll() is None])
+        return first_rc, first_rank
+
+    @staticmethod
+    def _kill_tree(procs):
+        """SIGTERM each straggler's process group, escalating to
+        SIGKILL after a short grace (workers start in their own
+        session, so wrapper grandchildren die too)."""
+        import signal
+        for q in procs:
+            try:
+                os.killpg(q.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                q.terminate()
+        deadline = time.time() + 10
+        for q in procs:
+            try:
+                q.wait(timeout=max(0.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                pass
+            if q.poll() is None:
+                try:
+                    os.killpg(q.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    q.kill()
+                q.wait()

@@ -37,6 +37,8 @@ __all__ = [
     "preemption_reentry", "chaos_inject", "chaos_survive",
     "serving_watcher_suspended", "env_health",
     "goodput_window", "goodput_regression", "goodput_env_degraded",
+    "dist_rank_failure", "checkpoint_commit_aborted",
+    "supervisor_restart", "supervisor_exhausted",
 ]
 
 
@@ -329,6 +331,48 @@ def chaos_survive(point, how):
     reg.counter("chaos.survived").inc()
     reg.counter("chaos.survived." + point).inc()
     reg.event("chaos.survive").emit(point=point, how=how)
+
+
+def dist_rank_failure(kind, tag, ranks, elapsed_s=None):
+    """A host collective or barrier gave up on peer rank(s) -- the
+    typed RankFailure/BarrierTimeout surface (distributed.py), never a
+    raw jaxlib deadline.  ``kind``: barrier/collective/abort."""
+    reg = _registry()
+    reg.counter("dist.rank_failures").inc()
+    reg.event("dist.rank_failure").emit(kind=kind, tag=tag,
+                                        ranks=list(ranks),
+                                        elapsed_s=elapsed_s)
+
+
+def checkpoint_commit_aborted(step, reason, rank=None):
+    """A sharded save aborted cleanly instead of committing -- staged
+    tmp swept, manifest never renamed in (the rank-death-safe commit
+    contract, checkpoint/sharded.py)."""
+    reg = _registry()
+    reg.counter("checkpoint.commit_aborted").inc()
+    reg.event("checkpoint.commit_abort").emit(step=step, reason=reason,
+                                              rank=rank)
+
+
+def supervisor_restart(generation, rank, exit_code, restarts):
+    """The elastic restart supervisor relaunched the world after a
+    rank death (mxnet_tpu/supervisor.py)."""
+    reg = _registry()
+    reg.counter("supervisor.restarts").inc()
+    reg.gauge("supervisor.generation").set(generation)
+    reg.event("supervisor.restart").emit(generation=generation,
+                                         rank=rank,
+                                         exit_code=exit_code,
+                                         restarts=restarts)
+
+
+def supervisor_exhausted(generation, budget):
+    """The supervisor's restart budget ran out -- it stops relaunching
+    and /healthz reads NOT_READY off the same state; alert here."""
+    reg = _registry()
+    reg.counter("supervisor.budget_exhausted").inc()
+    reg.event("supervisor.exhausted").emit(generation=generation,
+                                           budget=budget)
 
 
 def serving_watcher_suspended(model, step, budget):
@@ -656,6 +700,34 @@ INSTRUMENTS = [
     _ii("goodput.env_degraded", "event", "goodput", 14,
         "one env-guarded window; payload carries the dispatch RTT -- "
         "must agree with the bench line's degraded_env flag"),
+    _ii("dist.rank_failures", "counter", "distributed", 15,
+        "host collectives/barriers that gave up on peer rank(s) -- "
+        "surfaced as typed RankFailure/BarrierTimeout naming the "
+        "rank, never a raw jaxlib deadline"),
+    _ii("dist.rank_failure", "event", "distributed", 15,
+        "one attributed failure; payload carries kind/tag/ranks/"
+        "elapsed"),
+    _ii("checkpoint.commit_aborted", "counter", "checkpoint", 15,
+        "sharded saves that aborted cleanly on a rank failure "
+        "(staging swept, manifest never committed -- the rank-death-"
+        "safe commit contract)"),
+    _ii("checkpoint.commit_abort", "event", "checkpoint", 15,
+        "one clean abort; payload carries step/reason/rank"),
+    _ii("supervisor.restarts", "counter", "supervisor", 15,
+        "elastic world relaunches after a rank death "
+        "(tools/launch.py --supervise)"),
+    _ii("supervisor.generation", "gauge", "supervisor", 15,
+        "current supervisor generation id (namespaces the "
+        "coordination-KV keys; bumped on every relaunch)"),
+    _ii("supervisor.restart", "event", "supervisor", 15,
+        "one relaunch; payload carries generation/dead rank/exit "
+        "code/restart count"),
+    _ii("supervisor.budget_exhausted", "counter", "supervisor", 15,
+        "supervisors whose restart budget ran out (terminal; "
+        "/healthz reads NOT_READY)"),
+    _ii("supervisor.exhausted", "event", "supervisor", 15,
+        "the terminal budget exhaustion; payload carries generation + "
+        "budget -- alert on this"),
     _ii("env.dispatch_roundtrip_us", "gauge", "bench", 13,
         "bench env-health dispatch round trip (the degraded_env "
         "basis)"),

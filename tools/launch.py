@@ -10,10 +10,18 @@ coordinator's address and each process's index:
 
   local mode:   ``launch.py -n 4 python train.py``      (one host)
   ssh mode:     ``launch.py -n 8 -H hostfile python train.py``
+  supervised:   ``launch.py -n 4 --supervise python train.py``
 
 Each worker gets MXNET_TPU_COORDINATOR / MXNET_TPU_NUM_PROCS /
 MXNET_TPU_PROC_ID; ``mxnet_tpu.distributed_init()`` (or user code) maps
 them onto ``jax.distributed.initialize``.
+
+``--supervise`` (local mode) routes through the elastic restart
+supervisor (``mxnet_tpu.supervisor``): a rank death tears the world
+down (survivors get their typed BarrierTimeout within ``--grace``),
+the generation id is bumped (MXNET_TPU_GENERATION -- workers resume
+via ``ContinuousTrainer.resume()``), and the world relaunches under a
+bounded ``--max-restarts`` budget.
 """
 from __future__ import annotations
 
@@ -169,10 +177,31 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=0,
                    help="coordinator port for ssh mode (default: derived "
                         "per job)")
+    p.add_argument("--supervise", action="store_true",
+                   help="elastic restart supervision (local mode): on "
+                        "any rank exit, tear down, bump the generation "
+                        "id, and relaunch under --max-restarts")
+    p.add_argument("--max-restarts", type=int, default=None,
+                   help="restart budget for --supervise (default: "
+                        "MXNET_TPU_SUPERVISOR_RESTARTS)")
+    p.add_argument("--grace", type=float, default=None,
+                   help="seconds survivors get to exit on their own "
+                        "typed error before the tree is killed "
+                        "(default: MXNET_TPU_SUPERVISOR_GRACE_S)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
+    if args.supervise:
+        if args.hostfile:
+            p.error("--supervise is local-mode only (ssh worlds need "
+                    "an external supervisor per host)")
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from mxnet_tpu.supervisor import Supervisor
+        return Supervisor(args.command, args.num_workers,
+                          max_restarts=args.max_restarts,
+                          grace_s=args.grace).run()
     if args.hostfile:
         return launch_ssh(args, args.command)
     return launch_local(args, args.command)
