@@ -72,6 +72,16 @@
 #                with the steady-state steps run under
 #                transfer_guard("disallow") and a seeded implicit
 #                host transfer proven to raise
+#   numlint -> numerics sanitizer gates (docs/numerics.md): the
+#              full-tree static pass (five dtype-hazard rules armed),
+#              then a LeNet TrainStep + bf16-ResNet18 TrainStep smoke
+#              under MXNET_TPU_NUMERICS_CHECK=1 -- two clean sentinel
+#              steps, then a chaos-seeded NaN at step 3 must raise
+#              NonFiniteError naming a real parameter -- whose
+#              compiled-HLO precision audit (half-accumulated dots,
+#              convert storms, bf16 reductions) must show zero drift
+#              against the committed ci/numerics_baseline.json
+#              (mxlint --numerics-diff)
 #   kernels -> Pallas kernel tier gates (docs/kernels.md): the
 #              interpret-mode kernel tests (registry policy, fused
 #              BN+ReLU numerics+vjp, flash op-level pallas path incl.
@@ -106,7 +116,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint kernels spmd serving chaos chaos_dist obs bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint numlint kernels spmd serving chaos chaos_dist obs bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -328,7 +338,7 @@ EOF
         python -m pytest tests/test_sync.py tests/test_dataio.py \
         tests/test_checkpoint.py tests/test_telemetry.py \
         tests/test_serving.py tests/test_chaos.py tests/test_obs.py \
-        tests/test_resilience.py \
+        tests/test_resilience.py tests/test_numerics.py \
         -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
@@ -455,6 +465,106 @@ EOF
     python -m mxnet_tpu.analysis --perf-diff \
         ci/perf_baseline.json "$pfdir/current.json" --json
     rm -rf "$pfdir"
+}
+
+run_numlint() {
+    log "numlint: full-tree static pass (five dtype-hazard rules armed)"
+    # the numerics rules ride the same framework as the lint stage;
+    # running --self here keeps this stage self-contained when invoked
+    # alone (ci/run_all.sh numlint)
+    python -m mxnet_tpu.analysis --self --json
+    log "numlint: sentinel + precision-audit gate (LeNet + bf16 ResNet18 TrainStep)"
+    nmdir=$(mktemp -d /tmp/mxtpu_num_ci.XXXXXX)
+    JAX_PLATFORMS=cpu MXNET_TPU_PROFILING=1 MXNET_TPU_NUMERICS_CHECK=1 \
+        python - "$nmdir" <<'EOF'
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import amp, chaos, gluon, profiling
+from mxnet_tpu.analysis import numerics
+from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+from mxnet_tpu.parallel import TrainStep
+
+nmdir = sys.argv[1]
+assert profiling.enabled(), "MXNET_TPU_PROFILING=1 did not arm capture"
+assert numerics.check_enabled(), \
+    "MXNET_TPU_NUMERICS_CHECK=1 did not arm the sentinel"
+assert mx.runtime.Features().is_enabled("NUMERICS")
+
+
+class NumLeNet(gluon.nn.HybridSequential):
+    """Named so the audit row is stable across CI runs."""
+
+
+net = NumLeNet()
+net.add(gluon.nn.Conv2D(8, 5, padding=2, activation="relu",
+                        layout="NCHW"),
+        gluon.nn.MaxPool2D(2, layout="NCHW"),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr,
+                 mesh=None)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(8, 1, 16, 16).astype(np.float32))
+y = mx.nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
+
+# the detection gate: two clean sentinel-checked steps, then a
+# chaos-seeded NaN at step 3 must surface as a typed NonFiniteError
+# naming a REAL parameter, caught by the sentinel (the injector only
+# poisons the batch; the fault flows through forward/backward)
+with chaos.scenario(seed=0):
+    chaos.on("numerics.nonfinite", numerics.poison_action, nth=3)
+    for _ in range(2):
+        loss = step(x, y)
+    loss.asnumpy()
+    try:
+        step(x, y)
+        raise SystemExit("chaos NaN at step 3 did not raise NonFiniteError")
+    except numerics.NonFiniteError as e:
+        pnames = {p.name for p in tr._params}
+        assert e.param in pnames, (e.param, pnames)
+        assert e.step == 3, e.step
+        assert e.kind == "nan", e.kind
+        print("sentinel gate ok: NonFiniteError(%s, step=%s, %s)"
+              % (e.param, e.step, e.kind))
+row = numerics.status_row()
+assert row["checks"] >= 3 and row["nonfinite"] == 1 \
+    and row["last"]["kind"] == "nan", row
+
+# bf16 half of the audit: the same net shape trained under amp bf16 +
+# a bf16 ResNet18 TrainStep give the auditor real half-precision HLO
+res = resnet18_v1(classes=10, thumbnail=True)
+res.initialize(ctx=mx.cpu())
+res.hybridize()
+rtr = gluon.Trainer(res.collect_params(), "sgd", {"learning_rate": 0.1},
+                    kvstore=None)
+rstep = TrainStep(res, gluon.loss.SoftmaxCrossEntropyLoss(), rtr,
+                  mesh=None)
+rx = mx.nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+ry = mx.nd.array(rng.randint(0, 10, (2,)).astype(np.float32))
+with amp.scope("bfloat16"):
+    for _ in range(2):
+        rloss = rstep(rx, ry)
+rloss.asnumpy()
+
+audit = numerics.save_audit(os.path.join(nmdir, "current.json"))
+labels = set(audit["executables"])
+assert "train_step:NumLeNet" in labels, labels
+assert "train_step:ResNetV1" in labels, labels
+print("numlint smoke ok: %d executables audited, %d advisories"
+      % (len(labels), len(audit["advisories"])))
+EOF
+    # gate: precision metrics vs the committed baseline -- a grown
+    # half-accum-dot/convert-storm/half-reduce share or an unblessed
+    # advisory exits 1 naming executable + kind; improvements pass
+    python -m mxnet_tpu.analysis --numerics-diff \
+        ci/numerics_baseline.json "$nmdir/current.json" --json
+    rm -rf "$nmdir"
 }
 
 run_shardlint() {

@@ -1,10 +1,15 @@
 """Dynamic loss scaling for fp16 AMP.
 
 Reference: ``python/mxnet/contrib/amp/loss_scaler.py :: LossScaler`` --
-scale doubles every ``scale_window`` clean steps, halves on overflow
-(detected with the ``multi_all_finite`` op).  bfloat16 shares fp32's
-exponent range, so bf16 mode does not need scaling; this exists for fp16
-parity and for users porting fp16 recipes.
+scale doubles every ``scale_window`` clean steps, halves on overflow.
+bfloat16 shares fp32's exponent range, so bf16 mode does not need
+scaling; this exists for fp16 parity and for users porting fp16 recipes.
+
+Overflow detection (ISSUE 16 satellite) shares the numerics sentinel's
+fused reduction: ONE jitted finite-check over the bucketed gradient set
+(``analysis.numerics.finite_all``) and ONE boolean device_get per step,
+timed into the ``dispatch.host_sync_time`` ledger (kind
+``amp.overflow_check``) -- not a host round-trip per gradient array.
 """
 from __future__ import annotations
 
@@ -19,15 +24,25 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, grad_arrays):
-        """True if any gradient contains inf/nan (reference: ``multi_all_finite``)."""
-        from ..ndarray import invoke
-        from ..ops.registry import get_op
+        """True if any gradient contains inf/nan (reference:
+        ``multi_all_finite``): one fused jitted check over the bucketed
+        gradient set, one device_get, one ``host_sync`` timer sample."""
+        import time
+
+        import numpy as np
+
+        from ..analysis import numerics as _numerics
+        from .. import telemetry as _telemetry
         grads = [g for g in grad_arrays if g is not None]
         if not grads:
             return False
-        ok = invoke(get_op("multi_all_finite"), grads,
-                    {"num_arrays": len(grads)})
-        return not bool(float(ok.asnumpy()[0]))
+        ok_dev = _numerics.finite_all(grads)
+        t0 = time.perf_counter()
+        ok = bool(np.asarray(ok_dev))
+        if _telemetry._ENABLED:
+            _telemetry.hooks.host_sync("amp.overflow_check",
+                                       time.perf_counter() - t0)
+        return not ok
 
     def update_scale(self, overflow):
         """Adjust after a step (reference: ``LossScaler.update_scale``)."""

@@ -31,6 +31,16 @@ device time is spent (docs/analysis.md):
   against the committed ``ci/sharding_baseline.json``
   (``collective-drift``); :func:`transfer_guard` makes silent in-step
   host transfers raise.
+- the numerics sanitizer (docs/numerics.md) -- five static
+  dtype-hazard rules (``bf16-sensitive-reduce``, ``unscaled-half-loss``,
+  ``half-optimizer-state``, ``implicit-downcast``,
+  ``nonfinite-guard-missing``), the compiled precision audit
+  :func:`numerics_audit` gated against ``ci/numerics_baseline.json``
+  (``numerics-drift``, ``mxlint --numerics-diff``), and the runtime
+  non-finite sentinel (:func:`finite_sentinel`,
+  ``MXNET_TPU_NUMERICS_CHECK=1``) raising typed
+  :class:`NonFiniteError` with first-offender attribution.
+  ``mxlint --sarif`` exports every pass's findings as SARIF 2.1.0.
 
 CLI: ``python -m mxnet_tpu.analysis`` (or the ``mxlint`` entry point);
 ``ci/run_all.sh lint`` runs it with ``--self``.  Add a rule with
@@ -48,6 +58,13 @@ from .sharding import (audit_sharding, collective_contract,
                        save_contract, transfer_guard)
 from .perf import (audit_hlo_text, diff_audit, load_audit, perf_audit,
                    save_audit)
+# numerics shares perf's save/load/diff_audit spelling; reach them as
+# analysis.numerics.save_audit etc.
+from . import numerics
+from .numerics import (NonFiniteError, finite_sentinel, finite_tree,
+                       numerics_audit)
+from . import sarif
+from .sarif import to_sarif, write_sarif
 from .cli import main
 
 __all__ = [
@@ -60,5 +77,8 @@ __all__ = [
     "diff_contract", "load_contract", "save_contract", "transfer_guard",
     "audit_hlo_text", "diff_audit", "load_audit", "perf_audit",
     "save_audit",
+    "numerics", "NonFiniteError", "finite_sentinel", "finite_tree",
+    "numerics_audit",
+    "sarif", "to_sarif", "write_sarif",
     "main",
 ]

@@ -34,9 +34,10 @@ def _build_parser():
         prog="mxlint",
         description="Static graph checker + trace-safety linter + "
                     "concurrency sanitizer + sharding sanitizer + "
-                    "perf linter + retrace auditor for mxnet_tpu "
-                    "(docs/analysis.md, docs/sharding.md, "
-                    "docs/perf_lint.md).")
+                    "perf linter + numerics sanitizer + retrace "
+                    "auditor for mxnet_tpu (docs/analysis.md, "
+                    "docs/sharding.md, docs/perf_lint.md, "
+                    "docs/numerics.md).")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint")
     ap.add_argument("--self", dest="self_check", action="store_true",
@@ -79,6 +80,17 @@ def _build_parser():
                          "transpose/unfused/pad-waste shares or "
                          "unblessed advisories -- the CI perflint "
                          "gate (docs/perf_lint.md)")
+    ap.add_argument("--numerics-diff", nargs=2,
+                    metavar=("BASELINE", "CURRENT"),
+                    help="diff two numerics-audit JSONs (written by "
+                         "analysis.numerics.save_audit) and fail on "
+                         "grown half-accum-dot/convert-storm/"
+                         "half-reduce shares or unblessed advisories "
+                         "-- the CI numlint gate (docs/numerics.md)")
+    ap.add_argument("--sarif", metavar="OUT",
+                    help="also write surviving findings (every pass) "
+                         "as a SARIF 2.1.0 log for CI annotation; "
+                         "exit-code contract unchanged")
     ap.add_argument("--disable", default="", metavar="RULES",
                     help="comma-separated rule ids to skip")
     ap.add_argument("--json", dest="as_json", action="store_true",
@@ -157,8 +169,8 @@ def _write_baseline(path, diags: List[Diagnostic]):
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # importing the passes registers their rules
-    from . import (concurrency, graph_check, perf, retrace, sharding,
-                   trace_lint)
+    from . import (concurrency, graph_check, numerics, perf, retrace,
+                   sharding, trace_lint)
 
     if args.list_rules:
         print(_list_rules())
@@ -245,9 +257,21 @@ def main(argv=None) -> int:
         diags.extend(d for d in perf.diff_audit(base, cur)
                      if d.rule not in ignore)
 
+    if args.numerics_diff:
+        base_path, cur_path = args.numerics_diff
+        try:
+            base = numerics.load_audit(base_path)
+            cur = numerics.load_audit(cur_path)
+        except (OSError, ValueError, KeyError) as e:
+            print("mxlint: cannot read numerics audit: %s" % e,
+                  file=sys.stderr)
+            return 2
+        diags.extend(d for d in numerics.diff_audit(base, cur)
+                     if d.rule not in ignore)
+
     if not paths and not args.graph and not run_retrace \
             and not args.changed and not args.collective_diff \
-            and not args.perf_diff:
+            and not args.perf_diff and not args.numerics_diff:
         _build_parser().print_usage()
         return 2
 
@@ -265,6 +289,10 @@ def main(argv=None) -> int:
         print("mxlint: wrote %d finding(s) to baseline %s"
               % (len(diags), args.write_baseline))
         return 0
+
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, diags)
 
     print(render_json(diags) if args.as_json else render_human(diags))
     failing = [d for d in diags

@@ -1,0 +1,1030 @@
+"""mxnumerics (ISSUE 16 tentpole): precision-flow sanitizer.
+
+Every precision-critical surface -- bf16 training, AMP loss scaling,
+fp32-sensitive reductions -- fails *silently*: a bf16 accumulation or an
+unscaled half-precision loss trains fine for 10k steps and then diverges
+with no attribution.  This pass guards all three layers, in the same
+two-layer shape as the sharding sanitizer (PR 7) and perflint (PR 10),
+plus a runtime sentinel:
+
+**Static layer** (AST, under the PR-1 rule framework; runs in
+``mxlint --self``):
+
+- ``bf16-sensitive-reduce``: a sum/mean/var/std/norm/softmax reduction
+  over a half-precision value inside a traced scope
+  (``hybrid_forward``/``_forward_impl``/jitted step fns) with no
+  explicit fp32 accumulation (``.astype(float32)`` upcast or
+  ``preferred_element_type=``) -- the layernorm/softmax/BN-stats
+  hazard: bf16 carries ~8 mantissa bits, so a long reduction loses
+  everything below 1/256 of the running sum.
+- ``unscaled-half-loss``: a half-precision loss fed to ``backward()``
+  with no LossScaler / ``amp.scale_loss`` in the dataflow -- fp16
+  gradients underflow to zero without scaling (bf16 shares fp32's
+  exponent range; fp16 does not).
+- ``half-optimizer-state``: optimizer state / EMA buffers created in
+  fp16/bf16 -- momentum and variance accumulate tiny deltas that a
+  half-precision store absorbs; state must be fp32 (the master-weights
+  discipline).
+- ``implicit-downcast``: an fp32 value or small Python-float constant
+  silently narrowed by mixed-dtype promotion landing in half precision
+  (a weak-typed scalar with a bf16 array stays bf16, so ``x + 1e-8``
+  is ``x`` exactly in bf16).
+- ``nonfinite-guard-missing``: ``log``/``rsqrt``/``reciprocal`` on an
+  unbounded input with no eps/clip guard in the same expression --
+  the first NaN factory every divergence postmortem finds.
+
+**Compiled layer**: :func:`numerics_audit` walks PR 6's persistent
+``profiling.store.compiled_executables()`` registry and audits the HLO
+of each executable: dot/conv ops whose accumulator (output) type equals
+a half-precision operand type (no fp32 accumulation), convert-op storms
+(convert bytes >= 15% of executable bytes, with ``op_name``
+provenance), and reductions computed entirely in bf16/f16.
+``save_audit``/``load_audit``/``diff_audit`` (schema
+``mxnumerics.audit.v1``) + the committed ``ci/numerics_baseline.json``
+gate drift exactly like perflint: ``mxlint --numerics-diff BASE CUR``
+errors on growth, passes on improvement (rule ``numerics-drift``;
+CI stage ``numlint``; docs/numerics.md).
+
+**Runtime layer**: the non-finite sentinel.  Behind
+``MXNET_TPU_NUMERICS_CHECK=1`` (one module-flag check when off),
+``TrainStep`` folds :func:`finite_tree` -- ONE fused in-graph
+isfinite-reduction over the bucketed gradients
+(``bucketing.dtype_groups``) -- into the compiled step, and
+``ContinuousTrainer``/``LossScaler`` share :func:`finite_all`, the
+eager twin (one jitted program, one boolean, one device_get).  On the
+first non-finite step an attribution pass names WHICH parameter went
+non-finite and raises :class:`NonFiniteError(param, step, kind)`.  The
+``numerics.nonfinite`` chaos fail point (action
+:func:`poison_action`) injects a NaN deterministically so the whole
+detection path is testable; ``numerics.*`` telemetry instruments are
+catalogued in ``hooks.INSTRUMENTS`` and ``/statusz`` carries a
+``numerics`` row.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import Diagnostic, rule
+from .sharding import (_call_name, _file_defs_and_assigns, _is_jit_call,
+                       _resolve_body)
+from .trace_lint import TRACED_SCOPES
+
+__all__ = [
+    "AUDIT_SCHEMA", "THRESHOLDS",
+    "audit_hlo_numerics", "numerics_audit", "save_audit", "load_audit",
+    "diff_audit",
+    "NonFiniteError", "check_enabled", "finite_tree", "finite_all",
+    "finite_sentinel", "attribute_nonfinite", "poison_nd",
+    "poison_action", "status_row",
+]
+
+# ----------------------------------------------------------------------
+# dtype spelling helpers (shared by all five static rules)
+# ----------------------------------------------------------------------
+
+_HALF_NAMES = {"float16", "bfloat16", "half"}
+_F32_NAMES = {"float32", "single", "float64", "double"}
+
+
+def _dtype_name(node) -> Optional[str]:
+    """The dtype a literal/attribute spells: ``'bfloat16'``,
+    ``np.float16``, ``jnp.bfloat16`` -> its name; None otherwise."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_half_dtype(node) -> bool:
+    return _dtype_name(node) in _HALF_NAMES
+
+
+def _is_wide_dtype(node) -> bool:
+    return _dtype_name(node) in _F32_NAMES
+
+
+def _dtype_kw(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+_CAST_METHODS = {"astype", "cast", "as_in_ctx", "as_type"}
+
+
+def _cast_target(expr) -> Optional[str]:
+    """``'half'``/``'wide'`` when ``expr`` is an explicit dtype cast
+    (``x.astype(bf16)``, ``F.cast(x, dtype='float16')``); else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    f = expr.func
+    cand = None
+    if isinstance(f, ast.Attribute) and f.attr in ("astype", "cast") \
+            and expr.args:
+        cand = expr.args[0]
+    dk = _dtype_kw(expr)
+    if dk is not None:
+        cand = dk
+    if cand is None:
+        return None
+    if _is_half_dtype(cand):
+        return "half"
+    if _is_wide_dtype(cand):
+        return "wide"
+    return None
+
+
+def _expr_half(expr, tainted) -> bool:
+    """Conservatively: does ``expr`` produce a half-precision value?
+
+    Half flows from explicit half casts / ``dtype=`` kwargs and from
+    names in ``tainted``; an explicit fp32 cast cleanses.  Mixed binops
+    follow JAX promotion: half op f32 widens, half op weak Python
+    scalar stays half."""
+    if expr is None:
+        return False
+    cast = _cast_target(expr)
+    if cast == "half":
+        return True
+    if cast == "wide":
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute):
+        return _expr_half(expr.value, tainted)
+    if isinstance(expr, ast.BinOp):
+        lh = _expr_half(expr.left, tainted)
+        rh = _expr_half(expr.right, tainted)
+        lw = isinstance(expr.left, ast.Constant)
+        rw = isinstance(expr.right, ast.Constant)
+        return (lh and (rh or rw)) or (rh and lw)
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_half(expr.operand, tainted)
+    if isinstance(expr, ast.Call):
+        # dtype-preserving op/method call: half in -> half out
+        if isinstance(expr.func, ast.Attribute) and \
+                _expr_half(expr.func.value, tainted):
+            return True
+        return any(_expr_half(a, tainted) for a in expr.args)
+    if isinstance(expr, (ast.Subscript, ast.Starred)):
+        return _expr_half(expr.value, tainted)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return any(_expr_half(e, tainted) for e in expr.elts)
+    return False
+
+
+def _expr_wide(expr, tainted32) -> bool:
+    """Does ``expr`` produce a deliberately-fp32 value (an explicit
+    upcast or a name carrying one)?"""
+    if expr is None:
+        return False
+    cast = _cast_target(expr)
+    if cast == "wide":
+        return True
+    if cast == "half":
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted32
+    if isinstance(expr, ast.Attribute):
+        return _expr_wide(expr.value, tainted32)
+    if isinstance(expr, ast.BinOp):
+        return _expr_wide(expr.left, tainted32) or \
+            _expr_wide(expr.right, tainted32)
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_wide(expr.operand, tainted32)
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Attribute) and \
+                _expr_wide(expr.func.value, tainted32):
+            return True
+        return any(_expr_wide(a, tainted32) for a in expr.args)
+    return False
+
+
+def _assign_targets(node) -> List[str]:
+    out = []
+    targets = node.targets if isinstance(node, ast.Assign) \
+        else [node.target]
+    for tgt in targets:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                out.append(n.id)
+    return out
+
+
+def _scope_taints(fn) -> Tuple[set, set]:
+    """(half_tainted, f32_tainted) name sets of one function scope,
+    propagated through assignments in source order (two passes to
+    catch forward-flowing reuse)."""
+    assigns = [n for n in ast.walk(fn)
+               if isinstance(n, (ast.Assign, ast.AugAssign))]
+    assigns.sort(key=lambda n: n.lineno)
+    half, wide = set(), set()
+    for _ in range(2):
+        for node in assigns:
+            value = node.value
+            names = _assign_targets(node)
+            if _expr_half(value, half):
+                half.update(names)
+                wide.difference_update(names)
+            elif _expr_wide(value, wide):
+                wide.update(names)
+                half.difference_update(names)
+    return half, wide
+
+
+def _jitted_fn_nodes(tree):
+    """Function defs passed to ``jax.jit`` (the perflint resolver)."""
+    defs, assigns = _file_defs_and_assigns(tree)
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node) and node.args:
+            body = _resolve_body(node.args[0], defs, assigns)
+            if body is not None and body[2] is not None:
+                out.append(body[2])
+    return out
+
+
+def _traced_and_jitted_scopes(tree):
+    scopes = [n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef)
+              and n.name in TRACED_SCOPES]
+    seen = {id(s) for s in scopes}
+    for fn in _jitted_fn_nodes(tree):
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            scopes.append(fn)
+    return scopes
+
+
+def _leaf_name(func) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+# ----------------------------------------------------------------------
+# bf16-sensitive-reduce
+# ----------------------------------------------------------------------
+
+# dtype-sensitive reductions: long accumulation chains where bf16's 8
+# mantissa bits lose everything below 1/256 of the running sum
+_REDUCE_NAMES = {"sum", "mean", "prod", "var", "std", "norm",
+                 "softmax", "log_softmax", "logsumexp", "cumsum"}
+
+
+def _has_f32_accum(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "preferred_element_type":
+            return True
+        if kw.arg in ("dtype", "acc_dtype") and _is_wide_dtype(kw.value):
+            return True
+    return False
+
+
+@rule("bf16-sensitive-reduce", "ast",
+      "A sum/mean/var/std/norm/softmax reduction over a half-precision "
+      "value inside a traced scope with no fp32 accumulation: bf16 "
+      "carries ~8 mantissa bits, so the running sum silently absorbs "
+      "every addend below 1/256 of its magnitude.  Upcast first "
+      "(x.astype('float32')) or pass preferred_element_type.")
+def _lint_bf16_reduce(tree, path, ctx):
+    for fn in _traced_and_jitted_scopes(tree):
+        half, _wide = _scope_taints(fn)
+        if not half:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _leaf_name(node.func)
+            if name not in _REDUCE_NAMES or _has_f32_accum(node):
+                continue
+            # method form x.sum(): the receiver carries the dtype;
+            # func form F.sum(x): the first tensor arg does
+            if isinstance(node.func, ast.Attribute) and \
+                    not isinstance(node.func.value, ast.Name):
+                src = node.func.value
+                hot = _expr_half(src, half)
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in half:
+                hot = True
+            else:
+                hot = any(_expr_half(a, half) for a in node.args)
+            if not hot:
+                continue
+            yield Diagnostic(
+                "bf16-sensitive-reduce",
+                "%s() reduces a half-precision value in traced scope "
+                "%r without fp32 accumulation; bf16/fp16 running sums "
+                "absorb addends below ~1/256 of their magnitude.  Did "
+                "you mean x.astype('float32').%s(...) or "
+                "preferred_element_type=jnp.float32?"
+                % (name, fn.name, name),
+                file=path, line=node.lineno)
+
+
+# ----------------------------------------------------------------------
+# unscaled-half-loss
+# ----------------------------------------------------------------------
+
+# any of these names in the enclosing scope marks the loss as scaled /
+# scaling-aware (LossScaler instance, amp.scale_loss, trainer AMP init)
+_SCALE_MARKERS = {"LossScaler", "scale_loss", "loss_scale", "amp",
+                  "loss_scaler", "unscale", "init_trainer"}
+
+
+def _scope_mentions_scaling(fn) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and n.id in _SCALE_MARKERS:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _SCALE_MARKERS:
+            return True
+    return False
+
+
+@rule("unscaled-half-loss", "ast",
+      "A half-precision loss fed to backward() with no LossScaler/"
+      "amp.scale_loss in the dataflow: fp16 gradients underflow to "
+      "zero unscaled (bf16 shares fp32's exponent range; fp16 does "
+      "not).  Wrap with amp.scale_loss(loss, trainer) or a LossScaler.")
+def _lint_unscaled_half_loss(tree, path, ctx):
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        half, _wide = _scope_taints(fn)
+        if not half or _scope_mentions_scaling(fn):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hot = False
+            if isinstance(f, ast.Attribute) and f.attr == "backward" \
+                    and _expr_half(f.value, half):
+                hot = True          # loss.backward()
+            elif _leaf_name(f) == "backward" and \
+                    any(_expr_half(a, half) for a in node.args):
+                hot = True          # autograd.backward(loss)
+            if not hot:
+                continue
+            yield Diagnostic(
+                "unscaled-half-loss",
+                "backward() on a half-precision loss in %r with no "
+                "loss scaling in scope; fp16 grads underflow unscaled. "
+                " Did you mean amp.scale_loss(loss, trainer).backward()"
+                " or a LossScaler?" % fn.name,
+                file=path, line=node.lineno)
+
+
+# ----------------------------------------------------------------------
+# half-optimizer-state
+# ----------------------------------------------------------------------
+
+import re as _re
+
+_ARRAY_CREATORS = {"zeros", "ones", "full", "empty", "zeros_like",
+                   "ones_like", "full_like", "array"}
+_STATE_FN_RE = _re.compile(r"create_state|_state$", _re.I)
+_STATE_NAME_RE = _re.compile(
+    r"(mom(entum)?|var(iance)?|mean|ema|avg|state|vhat|mhat|velocity|"
+    r"accum)", _re.I)
+
+
+@rule("half-optimizer-state", "ast",
+      "Optimizer state / EMA buffer created in fp16/bf16: momentum and "
+      "variance accumulate per-step deltas ~1/1000 of their magnitude, "
+      "which a half-precision store absorbs entirely.  Keep state fp32 "
+      "(the master-weights discipline) and cast at apply time.")
+def _lint_half_optimizer_state(tree, path, ctx):
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        in_state_fn = bool(_STATE_FN_RE.search(fn.name))
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.Return)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and _leaf_name(value.func) in _ARRAY_CREATORS):
+                continue
+            dk = _dtype_kw(value)
+            if dk is None or not _is_half_dtype(dk):
+                continue
+            if isinstance(node, ast.Return):
+                statey = in_state_fn
+            else:
+                names = _assign_targets(node)
+                attrs = [t.attr for tgt in (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+                    for t in ast.walk(tgt) if isinstance(t, ast.Attribute)]
+                statey = in_state_fn or any(
+                    _STATE_NAME_RE.search(nm) for nm in names + attrs)
+            if not statey:
+                continue
+            yield Diagnostic(
+                "half-optimizer-state",
+                "%s(dtype=%s) creates optimizer state in half "
+                "precision in %r; per-step deltas underflow the store. "
+                " Did you mean dtype='float32' (cast at apply time)?"
+                % (_leaf_name(value.func), _dtype_name(dk), fn.name),
+                file=path, line=value.lineno)
+
+
+# ----------------------------------------------------------------------
+# implicit-downcast
+# ----------------------------------------------------------------------
+
+# bf16 resolves ~2^-8 relative; a Python float below this absolute
+# threshold next to O(1) half activations is at absorption risk
+_WEAK_CONST_MAX = 2.0 ** -8
+
+
+@rule("implicit-downcast", "ast",
+      "An fp32 value or small Python-float constant narrowed by "
+      "mixed-dtype promotion landing in half precision: a weak-typed "
+      "scalar with a bf16 array stays bf16 (x + 1e-8 is exactly x), "
+      "and .astype(half) on a deliberate fp32 upcast throws the "
+      "precision away.  Materialize constants at fp32 and keep the "
+      "compute wide until the final cast.")
+def _lint_implicit_downcast(tree, path, ctx):
+    for fn in _traced_and_jitted_scopes(tree):
+        half, wide = _scope_taints(fn)
+        for node in ast.walk(fn):
+            # form (a): tiny weak float absorbed by a half operand
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, (ast.Add, ast.Sub)) and half:
+                for c, other in ((node.left, node.right),
+                                 (node.right, node.left)):
+                    if not (isinstance(c, ast.Constant)
+                            and isinstance(c.value, float)):
+                        continue
+                    if not (0.0 < abs(c.value) < _WEAK_CONST_MAX):
+                        continue
+                    if _expr_half(other, half):
+                        yield Diagnostic(
+                            "implicit-downcast",
+                            "python float %g with a half-precision "
+                            "operand in traced scope %r is weak-typed: "
+                            "promotion lands bf16/fp16 and the "
+                            "constant is absorbed (bf16 resolves "
+                            "~2^-8).  Did you mean to upcast first "
+                            "(x.astype('float32') + %g)?"
+                            % (c.value, fn.name, c.value),
+                            file=path, line=node.lineno)
+            # form (b): a deliberate fp32 value cast back down to half
+            if isinstance(node, ast.Call) and wide:
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                        and node.args and _is_half_dtype(node.args[0]) \
+                        and _expr_wide(f.value, wide):
+                    yield Diagnostic(
+                        "implicit-downcast",
+                        ".astype(%r) narrows a deliberate fp32 value "
+                        "back to half precision in traced scope %r; "
+                        "keep the accumulation wide until the final "
+                        "output cast" % (_dtype_name(node.args[0]),
+                                         fn.name),
+                        file=path, line=node.lineno)
+
+
+# ----------------------------------------------------------------------
+# nonfinite-guard-missing
+# ----------------------------------------------------------------------
+
+_NONFINITE_FNS = {"log", "log2", "log10", "rsqrt", "reciprocal"}
+_GUARD_CALLS = {"maximum", "clip", "clamp", "abs", "exp", "softmax",
+                "sigmoid", "softplus", "square", "relu", "where"}
+_EPS_NAME_RE = _re.compile(r"eps|epsilon|delta|tiny", _re.I)
+
+
+def _arg_guarded(expr) -> bool:
+    """Is the argument expression bounded away from the pole -- an eps
+    addition, a clip/maximum/abs/exp wrap, or a literal?"""
+    if isinstance(expr, ast.Constant):
+        return True
+    for n in ast.walk(expr):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, (ast.Add,
+                                                          ast.Sub)):
+            for side in (n.left, n.right):
+                if isinstance(side, ast.Constant) and \
+                        isinstance(side.value, (int, float)) and \
+                        side.value != 0:
+                    return True
+                if isinstance(side, ast.Name) and \
+                        _EPS_NAME_RE.search(side.id):
+                    return True
+                if isinstance(side, ast.Attribute) and \
+                        _EPS_NAME_RE.search(side.attr):
+                    return True
+        if isinstance(n, ast.Call) and _leaf_name(n.func) in _GUARD_CALLS:
+            return True
+        if isinstance(n, ast.Name) and _EPS_NAME_RE.search(n.id):
+            return True
+    return False
+
+
+@rule("nonfinite-guard-missing", "ast",
+      "log/rsqrt/reciprocal on an unbounded input inside a traced "
+      "scope with no eps/clip guard in the expression: the first NaN "
+      "factory every divergence postmortem finds.  Guard the argument "
+      "(log(x + eps), rsqrt(var + eps), clip/maximum first).")
+def _lint_nonfinite_guard(tree, path, ctx):
+    for fn in _traced_and_jitted_scopes(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _leaf_name(node.func)
+            if name not in _NONFINITE_FNS or not node.args:
+                continue
+            if any(kw.arg is not None and _EPS_NAME_RE.search(kw.arg)
+                   for kw in node.keywords):
+                continue
+            if _arg_guarded(node.args[0]):
+                continue
+            yield Diagnostic(
+                "nonfinite-guard-missing",
+                "%s() on an unguarded input in traced scope %r can go "
+                "non-finite at the pole.  Did you mean %s(x + eps) or "
+                "a maximum/clip guard?" % (name, fn.name, name),
+                file=path, line=node.lineno)
+
+
+# ======================================================================
+# Compiled layer: the HLO precision auditor
+# ======================================================================
+
+AUDIT_SCHEMA = "mxnumerics.audit.v1"
+
+_HALF_HLO = {"bf16", "f16"}
+
+# convert-storm fires when convert-op bytes reach this share of the
+# executable's byte traffic; the dot/reduce advisories fire on presence
+# (their share metrics gate growth via diff_audit)
+THRESHOLDS = {
+    "convert_share": 0.15,
+}
+
+
+def audit_hlo_numerics(text: str) -> Dict:
+    """Raw precision counters of one compiled module's HLO text.
+
+    Walks every computation once (fusion bodies inclusive -- the dtype
+    hazards live on the instructions themselves, wherever XLA fused
+    them) and counts: convert-op bytes with ``op_name`` provenance,
+    dot/conv ops whose output (accumulator) dtype is a half operand
+    dtype, and reduce ops whose in+out dtypes are both half.
+    """
+    from ..profiling import hlo
+
+    _entry, comps, _refs = hlo.parse_module(text)
+    out = {
+        "bytes_total": 0,
+        "convert_bytes": 0, "convert_ops": {},       # op_name -> bytes
+        "half_dot_bytes": 0, "mxu_bytes": 0,
+        "half_dots": {},                             # op_name -> bytes
+        "half_reduce_bytes": 0, "reduce_bytes": 0,
+        "half_reduces": {},                          # op_name -> bytes
+    }
+    for _name, instrs in comps.items():
+        for ins in instrs:
+            op = ins.opcode
+            if op in hlo._SKIP or op in ("fusion", "while", "conditional",
+                                         "call") or op.startswith("async-"):
+                continue
+            nbytes = hlo._nbytes(ins.operand_shapes) + \
+                hlo._nbytes(ins.out_shapes)
+            out["bytes_total"] += nbytes
+            key = ins.op_name or op
+            if op == "convert":
+                out["convert_bytes"] += nbytes
+                out["convert_ops"][key] = \
+                    out["convert_ops"].get(key, 0) + nbytes
+            elif op in ("dot", "convolution"):
+                out["mxu_bytes"] += nbytes
+                half_in = {dt for dt, _dims in ins.operand_shapes
+                           if dt in _HALF_HLO}
+                out_half = any(dt in half_in
+                               for dt, _dims in ins.out_shapes)
+                if half_in and out_half:
+                    out["half_dot_bytes"] += nbytes
+                    out["half_dots"][key] = \
+                        out["half_dots"].get(key, 0) + nbytes
+            elif op in ("reduce", "reduce-window"):
+                out["reduce_bytes"] += nbytes
+                # pred-typed reductions (any/all -- e.g. the sentinel's
+                # own isfinite fold) carry no accumulation precision
+                dts = [dt for dt, _dims in list(ins.operand_shapes)
+                       + list(ins.out_shapes) if dt != "pred"]
+                if dts and all(dt in _HALF_HLO for dt in dts):
+                    out["half_reduce_bytes"] += nbytes
+                    out["half_reduces"][key] = \
+                        out["half_reduces"].get(key, 0) + nbytes
+    return out
+
+
+def _merge_counters(agg: Dict, cur: Dict):
+    for k, v in cur.items():
+        if isinstance(v, dict):
+            slot = agg.setdefault(k, {})
+            for nm, b in v.items():
+                slot[nm] = slot.get(nm, 0) + b
+        else:
+            agg[k] = agg.get(k, 0) + v
+
+
+def _metrics_of(counters: Dict) -> Dict:
+    total = counters["bytes_total"] or 1
+    mxu = counters["mxu_bytes"] or 1
+    red = counters["reduce_bytes"] or 1
+    return {
+        "convert_share": round(counters["convert_bytes"] / total, 4),
+        "half_accum_dot_share": round(
+            counters["half_dot_bytes"] / mxu, 4),
+        "half_reduce_share": round(
+            counters["half_reduce_bytes"] / red, 4),
+        "bytes_total": counters["bytes_total"],
+    }
+
+
+def _top(d: Dict, n=3) -> List[str]:
+    return [nm for nm, _b in sorted(d.items(), key=lambda kv: -kv[1])[:n]]
+
+
+def _advisories_for(label: str, metrics: Dict, counters: Dict,
+                    thresholds: Dict) -> List[Dict]:
+    adv = []
+    if metrics["half_accum_dot_share"] > 0:
+        names = _top(counters["half_dots"])
+        adv.append({
+            "kind": "half-accum-dot",
+            "share": metrics["half_accum_dot_share"],
+            "op_names": names,
+            "message": "%.0f%% of %r's MXU bytes are dot/conv ops "
+                       "accumulating in their half-precision operand "
+                       "type (top scopes: %s); pass "
+                       "preferred_element_type=jnp.float32 so the MXU "
+                       "accumulates fp32"
+                       % (100 * metrics["half_accum_dot_share"], label,
+                          ", ".join(names) or "<unnamed>"),
+        })
+    if metrics["convert_share"] >= thresholds["convert_share"]:
+        names = _top(counters["convert_ops"])
+        adv.append({
+            "kind": "convert-storm",
+            "share": metrics["convert_share"],
+            "op_names": names,
+            "message": "%.0f%% of %r's memory traffic is dtype "
+                       "converts (top scopes: %s) -- a mixed-precision "
+                       "boundary is thrashing; align dtypes across the "
+                       "op chain or move the cast outside the hot loop"
+                       % (100 * metrics["convert_share"], label,
+                          ", ".join(names) or "<unnamed>"),
+        })
+    if metrics["half_reduce_share"] > 0:
+        names = _top(counters["half_reduces"])
+        adv.append({
+            "kind": "half-reduce",
+            "share": metrics["half_reduce_share"],
+            "op_names": names,
+            "message": "%.0f%% of %r's reduction bytes accumulate "
+                       "entirely in bf16/fp16 (top scopes: %s); "
+                       "upcast the reduction input to fp32 -- the "
+                       "static bf16-sensitive-reduce rule names the "
+                       "source sites"
+                       % (100 * metrics["half_reduce_share"], label,
+                          ", ".join(names) or "<unnamed>"),
+        })
+    adv.sort(key=lambda a: -a["share"])
+    return adv
+
+
+def numerics_audit(thresholds=None) -> Dict:
+    """Audit every executable the profiling capture surface registered
+    for precision hazards; same walk as ``perf.perf_audit`` (lowering
+    hits jax's executable cache).  Returns the ``mxnumerics.audit.v1``
+    artifact CI diffs against ``ci/numerics_baseline.json``."""
+    import jax
+    from ..profiling import store
+
+    th = dict(THRESHOLDS)
+    if thresholds:
+        th.update(thresholds)
+    merged: Dict[str, Dict] = {}
+    for label, compiled in store.compiled_executables():
+        try:
+            text = compiled.as_text()
+        except Exception:
+            continue
+        counters = audit_hlo_numerics(text)
+        if label in merged:
+            _merge_counters(merged[label], counters)
+        else:
+            merged[label] = counters
+    execs = {}
+    for label, counters in merged.items():
+        metrics = _metrics_of(counters)
+        execs[label] = {
+            "metrics": metrics,
+            "advisories": _advisories_for(label, metrics, counters, th),
+        }
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    ranked = sorted(
+        (dict(a, executable=label)
+         for label, e in execs.items() for a in e["advisories"]),
+        key=lambda a: -a["share"])
+    return {
+        "schema": AUDIT_SCHEMA,
+        "backend": backend,
+        "thresholds": th,
+        "executables": execs,
+        "advisories": ranked,
+    }
+
+
+def save_audit(path: str, audit=None) -> Dict:
+    """Write the current numerics audit as JSON (the artifact CI diffs
+    against the committed ``ci/numerics_baseline.json``)."""
+    audit = audit if audit is not None else numerics_audit()
+    with open(path, "w") as f:
+        json.dump(audit, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return audit
+
+
+def load_audit(path: str) -> Dict:
+    with open(path) as f:
+        data = json.load(f)
+    if data.get("schema") != AUDIT_SCHEMA:
+        raise ValueError("%s is not a %s artifact (schema=%r)"
+                         % (path, AUDIT_SCHEMA, data.get("schema")))
+    return data
+
+
+def _audit_tol() -> float:
+    try:
+        return float(os.environ.get("MXNET_TPU_NUMERICS_AUDIT_TOL",
+                                    "0.02"))
+    except ValueError:
+        return 0.02
+
+
+# share metrics where GROWTH is a precision regression
+_GROWTH_METRICS = ("convert_share", "half_accum_dot_share",
+                   "half_reduce_share")
+
+
+def diff_audit(baseline: Dict, current: Dict,
+               tol: Optional[float] = None) -> List[Diagnostic]:
+    """Precision drift of ``current`` vs the blessed ``baseline``:
+
+    - an advisory KIND the baseline doesn't carry for that executable
+      (or a brand-new executable auditing with advisories) -> error;
+    - a share metric (convert / half-accum-dot / half-reduce) grown
+      more than ``tol`` (absolute; default
+      ``MXNET_TPU_NUMERICS_AUDIT_TOL`` = 0.02) -> error.
+
+    Improvements (smaller shares, fewer advisories) pass silently --
+    re-bless with :func:`save_audit` after an intentional change."""
+    tol = _audit_tol() if tol is None else tol
+    diags: List[Diagnostic] = []
+    base_ex = baseline.get("executables", {})
+    for label, cur in sorted(current.get("executables", {}).items()):
+        base = base_ex.get(label, {"metrics": {}, "advisories": []})
+        blessed = {a["kind"] for a in base.get("advisories", [])}
+        for a in cur.get("advisories", []):
+            if a["kind"] not in blessed:
+                diags.append(Diagnostic(
+                    "numerics-drift",
+                    "executable %r gained unblessed %r advisory "
+                    "(precision share %.1f%%): %s -- fix the "
+                    "regression or re-bless via analysis.numerics."
+                    "save_audit" % (label, a["kind"], 100 * a["share"],
+                                    a["message"]),
+                    node=label))
+        bm = base.get("metrics", {})
+        cm = cur.get("metrics", {})
+        for m in _GROWTH_METRICS:
+            b, c = bm.get(m, 0.0), cm.get(m, 0.0)
+            if c > b + tol:
+                diags.append(Diagnostic(
+                    "numerics-drift",
+                    "executable %r: %s grew %.4f -> %.4f (tolerance "
+                    "%.4f); the compiled step lost precision headroom "
+                    "vs what the baseline blesses" % (label, m, b, c,
+                                                      tol),
+                    node=label))
+    return diags
+
+
+@rule("numerics-drift", "compiled",
+      "A registered executable's precision metrics (half-accumulated "
+      "dots, convert-storm bytes, bf16 reductions) drifted past the "
+      "committed ci/numerics_baseline.json -- a named, gated precision "
+      "regression.  Gate: mxlint --numerics-diff.")
+def _rule_numerics_drift(baseline, current):
+    return diff_audit(baseline, current)
+
+
+# ======================================================================
+# Runtime layer: the non-finite sentinel
+# ======================================================================
+
+# THE flag the hot paths check: one module-attribute read when off.
+_CHECK = os.environ.get("MXNET_TPU_NUMERICS_CHECK", "0") != "0"
+
+# sentinel state the /statusz row reads
+_STATE = {"checks": 0, "nonfinite": 0, "last": None}
+
+
+def check_enabled() -> bool:
+    """Is the non-finite sentinel armed (``MXNET_TPU_NUMERICS_CHECK``)?"""
+    return _CHECK
+
+
+def _set_check(flag):
+    """Test/scenario hook: flip the sentinel without re-importing."""
+    global _CHECK
+    prev = _CHECK
+    _CHECK = bool(flag)
+    return prev
+
+
+class NonFiniteError(RuntimeError):
+    """A gradient (or the loss) went NaN/Inf; ``param`` names the first
+    offender, ``step`` the update count, ``kind`` is ``'nan'`` or
+    ``'inf'``.  Raised by the sentinel AFTER the framework state was
+    restored to the pre-step values (the branchless overflow-skip keeps
+    old weights on a non-finite step), so a handler can lower the lr /
+    re-seed data and continue."""
+
+    def __init__(self, param, step, kind):
+        super().__init__(
+            "non-finite gradient: %s in parameter %r at step %s "
+            "(weights kept at their pre-step values; see docs/"
+            "numerics.md)" % (kind, param, step))
+        self.param = param
+        self.step = step
+        self.kind = kind
+
+
+def _float_leaves(leaves):
+    import jax.numpy as jnp
+    return [x for x in leaves
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype,
+                                                      jnp.floating)]
+
+
+def finite_tree(leaves):
+    """ONE fused in-graph isfinite-reduction over ``leaves``: bucket by
+    dtype (``bucketing.dtype_groups``), flatten each bucket into one
+    buffer, reduce each with a single ``all(isfinite)``, AND the
+    per-bucket booleans.  Traceable -- TrainStep folds this into the
+    compiled step, so the clean path costs one boolean output and no
+    extra host sync.  Non-float leaves (int step counters) are skipped."""
+    import jax.numpy as jnp
+    from .. import bucketing
+    fl = _float_leaves(leaves)
+    if not fl:
+        return jnp.bool_(True)
+    ok = jnp.bool_(True)
+    for _dt, idxs in bucketing.dtype_groups(fl):
+        buf = bucketing.flatten_group(fl, idxs, jnp)
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(buf)))
+    return ok
+
+
+# eager twin: one cached jitted program per (shape, dtype) signature
+_FUSED_CACHE: Dict[tuple, object] = {}
+
+
+def finite_all(arrays):
+    """The eager twin of :func:`finite_tree`: ONE jitted fused finite
+    check over the bucketed array set, returning a device boolean (the
+    caller decides when to pay the single device_get).  The jitted
+    program is cached per (shape, dtype) signature -- steady-state cost
+    is one dispatch, no per-array host round trips."""
+    import jax
+    arrs = [a._data if hasattr(a, "_data") else a for a in arrays]
+    arrs = _float_leaves(arrs)
+    if not arrs:
+        import jax.numpy as jnp
+        return jnp.bool_(True)
+    key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda *xs: finite_tree(list(xs)))
+        _FUSED_CACHE[key] = fn
+    return fn(*arrs)
+
+
+def attribute_nonfinite(named) -> Optional[Tuple[str, str]]:
+    """The attribution pass: scan ``(name, array)`` pairs host-side
+    (failure path only) and return ``(name, kind)`` of the first
+    non-finite entry -- NaN reported before Inf when both occur."""
+    import numpy as np
+    first_inf = None
+    for name, a in named:
+        x = a._data if hasattr(a, "_data") else a
+        x = np.asarray(x)
+        if not np.issubdtype(x.dtype, np.floating):
+            continue
+        if np.isnan(x).any():
+            return name, "nan"
+        if first_inf is None and np.isinf(x).any():
+            first_inf = (name, "inf")
+    return first_inf
+
+
+def note_check(seconds):
+    """Book one sentinel check (the /statusz counter + the
+    ``numerics.checks`` / ``numerics.check_time`` instruments)."""
+    _STATE["checks"] += 1
+    from .. import telemetry as _telemetry
+    if _telemetry._ENABLED:
+        _telemetry.hooks.numerics_check(seconds)
+
+
+def record_nonfinite(param, step, kind):
+    """Book a detected non-finite step: telemetry + the /statusz row."""
+    _STATE["nonfinite"] += 1
+    _STATE["last"] = {"param": param, "step": step, "kind": kind}
+    from .. import telemetry as _telemetry
+    if _telemetry._ENABLED:
+        _telemetry.hooks.numerics_nonfinite(param, step, kind)
+
+
+def finite_sentinel(named, step=None):
+    """Check named gradients/params for non-finites in ONE fused jitted
+    reduction + ONE boolean device_get; raise :class:`NonFiniteError`
+    naming the first offender.  Disarmed (the default): one module-flag
+    check, the arguments are never touched.
+
+    ``named``: iterable of ``(name, array)`` pairs (NDArray or jax).
+    Returns True on a clean pass."""
+    if not _CHECK:
+        return True
+    import time
+
+    import numpy as np
+    named = list(named)
+    ok_dev = finite_all([a for _n, a in named])
+    t0 = time.perf_counter()
+    ok = bool(np.asarray(ok_dev))
+    note_check(time.perf_counter() - t0)
+    if ok:
+        return True
+    hit = attribute_nonfinite(named)
+    param, kind = hit if hit is not None else ("<unattributed>",
+                                               "nonfinite")
+    record_nonfinite(param, step, kind)
+    raise NonFiniteError(param, step, kind)
+
+
+# -- chaos integration -------------------------------------------------
+
+def poison_action(ctx):
+    """The ``numerics.nonfinite`` chaos action: instead of raising,
+    mark the caller's ``box`` so IT poisons the in-flight batch with a
+    NaN -- the fault then flows through forward/backward and must be
+    caught by the sentinel, not by the injector.  Arm with::
+
+        chaos.on("numerics.nonfinite", numerics.poison_action, nth=3)
+    """
+    box = ctx.get("box")
+    if box is not None:
+        box["poison"] = True
+
+
+def poison_nd(x):
+    """NaN-poison element 0 of a (float) array/NDArray, preserving
+    wrapper type -- the deterministic fault ``poison_action`` asks the
+    training step to inject into its own batch."""
+    import jax.numpy as jnp
+    data = x._data if hasattr(x, "_data") else x
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return x
+    flat = data.reshape(-1).at[0].set(jnp.nan)
+    poisoned = flat.reshape(data.shape)
+    if hasattr(x, "_data"):
+        from ..ndarray import NDArray
+        return NDArray(poisoned)
+    return poisoned
+
+
+def status_row() -> Dict:
+    """The ``/statusz`` numerics row: sentinel arm state, checks run,
+    non-finite steps seen, and the last attribution."""
+    return {"armed": _CHECK, "checks": _STATE["checks"],
+            "nonfinite": _STATE["nonfinite"], "last": _STATE["last"]}

@@ -255,6 +255,24 @@ _VARS = [
            "analysis.perf.diff_audit).  A metric grown past baseline + "
            "tolerance errors naming the executable; improvements pass "
            "(docs/perf_lint.md)."),
+    EnvVar("MXNET_TPU_NUMERICS_CHECK", bool, False,
+           "'1' arms the non-finite sentinel "
+           "(analysis.numerics.finite_sentinel): TrainStep and "
+           "ContinuousTrainer fold ONE fused isfinite-reduction over "
+           "the dtype-bucketed gradients into each step (one boolean, "
+           "one device_get) and on the first non-finite step run an "
+           "attribution pass naming WHICH parameter went NaN/Inf, "
+           "raising NonFiniteError(param, step, kind) with the weights "
+           "still at their pre-step values.  '0' (default): one "
+           "module-flag check, zero per-step work (docs/numerics.md)."),
+    EnvVar("MXNET_TPU_NUMERICS_AUDIT_TOL", float, 0.02,
+           "Absolute growth tolerance for the numerics auditor's share "
+           "metrics (half-accumulated dot/conv bytes, convert-storm "
+           "bytes, all-half reductions) when diffing against the "
+           "blessed ci/numerics_baseline.json (mxlint --numerics-diff "
+           "/ analysis.numerics.diff_audit).  A metric grown past "
+           "baseline + tolerance errors naming the executable; "
+           "improvements pass (docs/numerics.md)."),
     EnvVar("MXNET_TPU_CKPT_QUARANTINE", bool, True,
            "Checkpoint discovery quarantine: a step that fails "
            "manifest/CRC verification during "

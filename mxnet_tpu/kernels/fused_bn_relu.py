@@ -217,8 +217,13 @@ def fused_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
         m2 = jnp.mean(y * y, axis=0)
         var = jnp.maximum(m2 - mean_y * mean_y, 0.0)
         mean = mean_y + shift
-        new_mean = momentum * moving_mean + (1 - momentum) * mean
-        new_var = momentum * moving_var + (1 - momentum) * var
+        # EMA blended in fp32, stored back at the aux dtype (same
+        # discipline as ops/nn._batch_norm): a weak-typed
+        # ``momentum * moving_mean`` would round at bf16 per step
+        new_mean = (momentum * moving_mean.astype(jnp.float32)
+                    + (1 - momentum) * mean).astype(moving_mean.dtype)
+        new_var = (momentum * moving_var.astype(jnp.float32)
+                   + (1 - momentum) * var).astype(moving_var.dtype)
     else:
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)

@@ -176,6 +176,11 @@ def statusz():
             continue
         if win is not None:
             goodput = win       # newest registered ledger wins
+    try:
+        from ..analysis import numerics as _numerics
+        numerics_row = _numerics.status_row()
+    except Exception:
+        numerics_row = None
     swap_ev = reg.get("serving.swap")
     occupancy = reg.get("serving.batch_occupancy")
     served = reg.get("serving.served_step")
@@ -198,5 +203,8 @@ def statusz():
         "bucket_occupancy": (occupancy.snapshot()
                              if occupancy is not None else None),
         "goodput": goodput,     # latest StepLedger window (obs.goodput)
+        # the non-finite sentinel: armed?, checks run, nonfinite steps
+        # seen, last attribution (analysis.numerics, docs/numerics.md)
+        "numerics": numerics_row,
         "heartbeats": dict(_heartbeats),
     }

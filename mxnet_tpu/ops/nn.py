@@ -292,10 +292,21 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
         m2 = jnp.mean(y * y, axis=reduce_axes)
         var = jnp.maximum(m2 - mean_y * mean_y, 0.0)
         mean = mean_y + c.reshape(mean_y.shape)
-        new_mean = momentum * moving_mean + (1 - momentum) * mean
-        new_var = momentum * moving_var + (1 - momentum) * var
+        # EMA blended in fp32, stored back at the aux dtype: with bf16
+        # running stats the weak-typed ``momentum * moving_mean``
+        # product would round at bf16 (8 mantissa bits) every step,
+        # and (1 - momentum) = 0.1-ish deltas drop below the store's
+        # resolution after a few hundred steps.
+        new_mean = (momentum * moving_mean.astype(jnp.float32)
+                    + (1 - momentum) * mean).astype(moving_mean.dtype)
+        new_var = (momentum * moving_var.astype(jnp.float32)
+                   + (1 - momentum) * var).astype(moving_var.dtype)
     else:
-        mean, var = moving_mean, moving_var
+        # eval path: upcast BEFORE the eps add -- in bf16,
+        # var + 1e-5 == var exactly, and rsqrt would run at 8 mantissa
+        # bits
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
         new_mean, new_var = moving_mean, moving_var
     inv = (lax.rsqrt(var + eps) * g).astype(jnp.float32)
     out = (xf - mean.reshape(bshape).astype(jnp.float32)) \

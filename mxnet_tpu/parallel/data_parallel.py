@@ -364,11 +364,13 @@ class TrainStep:
 
             # Branchless fp16 overflow skip: if any gradient is non-finite
             # the select below keeps the old weights/states (the XLA
-            # answer to the reference's skip-update-on-overflow).
-            all_finite = jnp.bool_(True)
-            for leaf in jax.tree_util.tree_leaves(grads):
-                all_finite = jnp.logical_and(all_finite,
-                                             jnp.all(jnp.isfinite(leaf)))
+            # answer to the reference's skip-update-on-overflow).  ONE
+            # fused isfinite-reduction over the dtype-bucketed gradient
+            # set (the numerics sentinel's in-graph form) -- one boolean
+            # output, no extra host sync on the clean path.
+            from ..analysis import numerics as _numerics
+            all_finite = _numerics.finite_tree(
+                jax.tree_util.tree_leaves(grads))
 
             lr_map = {i: lrs[k] for k, i in enumerate(idxs)}
             wd_map = {i: wds[k] for k, i in enumerate(idxs)}
@@ -408,6 +410,29 @@ class TrainStep:
                                                  svals.get(i))
             return new_w, new_s, aux, mean_loss, all_finite
 
+        def probe_fn(pvals, data, label, rng, loss_scale):
+            # failure-path attribution (numerics sentinel): recompute
+            # the gradients from the SAME params/batch/rng -- on a
+            # non-finite step the where-select above kept the old
+            # weights, so pvals reproduce the faulting step exactly --
+            # and hand them back for a host-side per-parameter scan.
+            # Never donated, compiled lazily on first non-finite step.
+            def loss_of(diff_pvals):
+                merged = dict(pvals)
+                merged.update(diff_pvals)
+                outs, aux = pure_fn(merged, [data], rng)
+                out_nd = [NDArray(o) for o in outs]
+                l = loss_fn(out_nd[0] if len(out_nd) == 1 else out_nd,
+                            NDArray(label))
+                ldata = l._data if isinstance(l, NDArray) else l
+                return jnp.sum(ldata) * loss_scale, jnp.mean(ldata)
+
+            diff_pvals = {name_by_idx[i]: pvals[name_by_idx[i]]
+                          for i in idxs}
+            (_, mean_loss), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_pvals)
+            return grads, mean_loss
+
         jit_kwargs = {}
         if self._mesh is not None:
             mesh = self._mesh
@@ -424,7 +449,11 @@ class TrainStep:
                 None, None, data_sh, label_sh, rep, rep, rep, rep, rep, rep)
         if self._donate:
             jit_kwargs["donate_argnums"] = (0, 1)
-        return jax.jit(step_fn, **jit_kwargs), idxs, pnames, pmap
+        # the attribution probe must NOT donate: it re-reads the live
+        # param buffers after a failed step
+        return (jax.jit(step_fn, **jit_kwargs),
+                jax.jit(probe_fn),  # mxlint: disable=undonated-train-state
+                idxs, pnames, pmap)
 
     # -- multi-step scan ----------------------------------------------
     def _build_scan(self, ivals, training):
@@ -435,7 +464,7 @@ class TrainStep:
         entirely (the reference's analog is engine-queued bulk execution;
         here the loop itself is on device).
         """
-        fn_single, idxs, pnames, pmap = self._build(
+        fn_single, _probe, idxs, pnames, pmap = self._build(
             [NDArray(ivals[0]._data[0]), NDArray(ivals[1]._data[0])],
             training)
         aux_names = None
@@ -644,6 +673,18 @@ class TrainStep:
                 self._block(data)
             self._ensure_states()
 
+        from ..analysis import numerics as _numerics
+        from .. import chaos as _chaos
+        # numerics.nonfinite chaos point: poison_action marks the box
+        # and THIS step injects the NaN into its own batch, so the
+        # fault flows through forward/backward and must be caught by
+        # the sentinel, not the injector (docs/numerics.md)
+        _box = {}
+        _chaos.fail_point("numerics.nonfinite", box=_box,
+                          step=opt.num_update + 1)
+        if _box.get("poison"):
+            data = _numerics.poison_nd(data)
+
         training = True
         from .. import amp as _amp
         key = (tuple(data.shape), str(data.dtype), tuple(label.shape),
@@ -652,7 +693,7 @@ class TrainStep:
         if entry is None:
             entry = self._build([data, label], training)
             self._cache[key] = entry
-        fn, idxs, pnames, pmap = entry
+        fn, probe, idxs, pnames, pmap = entry
 
         # host-side per-step bookkeeping (matches Optimizer._update_count)
         for i in idxs:
@@ -693,10 +734,12 @@ class TrainStep:
             label = "train_step:%s" % type(self._block).__name__
             self._profiling_hook(label, fn, t0p,
                                  time.perf_counter() - t0p, bs)
+        finite_host = None
         if scaler is not None:
             # host sync only in fp16 mode: the scaler's growth/backoff
             # counters live on the host (reference LossScaler semantics)
-            scaler.update_scale(not bool(np.asarray(all_finite)))
+            finite_host = bool(np.asarray(all_finite))
+            scaler.update_scale(not finite_host)
 
         # rebind updated weights/states/aux into the framework objects
         # (ALL params: buffers were donated, unchanged ones aliased through)
@@ -712,4 +755,30 @@ class TrainStep:
                 grad = p._data._grad if p._data is not None else None
                 p._data = NDArray(aux[p.name])
                 p._data._grad = grad
+
+        if _numerics.check_enabled():
+            # the sentinel reads the ONE boolean the compiled step
+            # already produced (shared with the fp16 scaler's fetch);
+            # framework state was rebound above -- on a non-finite step
+            # the where-select kept the pre-step weights, so raising
+            # here leaves the model consistent and restartable
+            t0s = time.perf_counter()
+            if finite_host is None:
+                finite_host = bool(np.asarray(all_finite))
+            _numerics.note_check(time.perf_counter() - t0s)
+            if not finite_host:
+                step_no = opt.num_update
+                # attribution pass: recompute this step's gradients
+                # from the restored params + the same batch/rng, then
+                # scan per-parameter host-side (failure path only)
+                grads, probe_loss = probe(new_w, args[2], args[3],
+                                          args[4], args[9])
+                names = [tr._params[i].name for i in idxs]
+                named = [(nm, grads[nm]) for nm in names if nm in grads]
+                hit = _numerics.attribute_nonfinite(
+                    named + [("loss", probe_loss)])
+                param, kind = hit if hit is not None else (
+                    "<unattributed>", "nonfinite")
+                _numerics.record_nonfinite(param, step_no, kind)
+                raise _numerics.NonFiniteError(param, step_no, kind)
         return NDArray(mean_loss)

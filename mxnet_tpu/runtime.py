@@ -63,6 +63,10 @@ def _detect():
         # inside a chaos.arm()/chaos.scenario() window, never in a
         # production process (no env var arms it)
         "CHAOS": _chaos_armed(),
+        # non-finite sentinel (analysis.numerics): whether
+        # MXNET_TPU_NUMERICS_CHECK armed the fused per-step isfinite
+        # check + first-offender attribution for this run
+        "NUMERICS": _numerics_check_enabled(),
         # request/step tracing (mx.obs): LIVE arm state, same contract
         # as the TELEMETRY row
         "OBS_TRACE": _obs_tracing(),
@@ -114,6 +118,14 @@ def _shard_check_enabled():
     # drag the whole lint stack into feature probing
     import os
     return os.environ.get("MXNET_TPU_SHARD_CHECK", "0") != "0"
+
+
+def _numerics_check_enabled():
+    # env-read directly (analysis.numerics.check_enabled() reads the
+    # same variable at import); importing mxnet_tpu.analysis here would
+    # drag the whole lint stack into feature probing
+    import os
+    return os.environ.get("MXNET_TPU_NUMERICS_CHECK", "0") != "0"
 
 
 def _try_import(mod):

@@ -148,9 +148,30 @@ class ContinuousTrainer:
             try:
                 x, y = self._data(step) if callable(self._data) \
                     else self._data
+                from ..analysis import numerics as _numerics
+                from .. import chaos as _chaos
+                # numerics.nonfinite chaos point: poison THIS batch so
+                # the fault flows through forward/backward and the
+                # sentinel (not the injector) must catch it
+                _box = {}
+                _chaos.fail_point("numerics.nonfinite", box=_box,
+                                  step=step)
+                if _box.get("poison"):
+                    x = _numerics.poison_nd(x)
                 with autograd.record():
                     loss = self.loss_fn(self.block(x), y)
                 loss.backward()
+                if _numerics.check_enabled():
+                    # ONE fused finite check over the named gradient
+                    # set; raises NonFiniteError(param, step, kind)
+                    # naming the first offender BEFORE the optimizer
+                    # applies the poisoned update
+                    _numerics.finite_sentinel(
+                        [(p.name, p._data._grad)
+                         for p in self.trainer._params
+                         if p._data is not None
+                         and p._data._grad is not None],
+                        step=step)
                 self.trainer.step(x.shape[0])
                 last = loss
                 if step % self.publish_every == 0:

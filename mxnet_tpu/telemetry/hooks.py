@@ -26,6 +26,7 @@ __all__ = [
     "op_dispatch", "host_sync", "compile_event", "trainer_step",
     "samples_per_sec", "kv_op", "dataloader_wait", "feed_produce",
     "feed_wait", "feed_overlap", "amp_overflow", "amp_rescale",
+    "numerics_check", "numerics_nonfinite",
     "checkpoint", "checkpoint_wait",
     "sync_contention", "sync_hold", "sync_watchdog", "sync_inversion",
     "profiling_capture", "profiling_step",
@@ -158,6 +159,25 @@ def amp_rescale(scale_before, scale_after):
     reg.gauge("amp.loss_scale").set(scale_after)
     reg.event("amp.rescale").emit(scale_before=scale_before,
                                   scale_after=scale_after)
+
+
+def numerics_check(seconds=None):
+    """One non-finite sentinel check ran (analysis.numerics; armed by
+    MXNET_TPU_NUMERICS_CHECK=1).  ``seconds`` is the host wall spent on
+    the one boolean device_get."""
+    reg = _registry()
+    reg.counter("numerics.checks").inc()
+    if seconds is not None:
+        reg.timer("numerics.check_time").observe(seconds)
+
+
+def numerics_nonfinite(param, step, kind):
+    """The sentinel attributed a non-finite step: ``param`` is the
+    first offending parameter (or ``loss``), ``kind`` nan/inf."""
+    reg = _registry()
+    reg.counter("numerics.nonfinite_steps").inc()
+    reg.event("numerics.nonfinite").emit(param=param, step=step,
+                                         kind=kind)
 
 
 def checkpoint(action, nbytes=None, seconds=None, **payload):
@@ -540,6 +560,15 @@ INSTRUMENTS = [
     _ii("amp.rescale", "event", "amp", 2,
         "loss-scale growth after a clean window"),
     _ii("amp.loss_scale", "gauge", "amp", 2, "current loss scale"),
+    _ii("numerics.checks", "counter", "numerics", 16,
+        "non-finite sentinel checks run (MXNET_TPU_NUMERICS_CHECK=1)"),
+    _ii("numerics.check_time", "timer", "numerics", 16,
+        "host wall per sentinel check (the one boolean device_get)"),
+    _ii("numerics.nonfinite_steps", "counter", "numerics", 16,
+        "steps the sentinel attributed a NaN/Inf gradient on"),
+    _ii("numerics.nonfinite", "event", "numerics", 16,
+        "one per attributed non-finite step; payload names the first "
+        "offending parameter, the step, and nan-vs-inf"),
     _ii("checkpoint", "event", "checkpoint", 2,
         "checkpoint save/restore; payload carries step/bytes/duration"),
     _ii("checkpoint.saves", "counter", "checkpoint", 3,

@@ -334,3 +334,83 @@ def test_trainstep_fp16_scaler_matches_unscaled_updates():
     a, b = run(False), run(True)
     for x, y in zip(a, b):
         np.testing.assert_allclose(x, y, rtol=1e-4, atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+# LossScaler edge cases + the fused overflow check (ISSUE 16 satellites)
+# ----------------------------------------------------------------------
+
+def test_loss_scaler_min_scale_floor():
+    s = amp.LossScaler(init_scale=2.0, min_scale=1.0)
+    s.update_scale(True)
+    assert s.loss_scale == 1.0
+    s.update_scale(True)                  # floored, not 0.5
+    assert s.loss_scale == 1.0
+
+
+def test_loss_scaler_doubles_exactly_at_window():
+    s = amp.LossScaler(init_scale=4.0, scale_window=3)
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 4.0            # not before the window
+    s.update_scale(False)
+    assert s.loss_scale == 8.0            # exactly at it
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 8.0            # clean-step counter reset
+
+
+def test_loss_scaler_overflow_restarts_window():
+    s = amp.LossScaler(init_scale=8.0, scale_window=2)
+    s.update_scale(False)                 # 1 clean step banked
+    s.update_scale(True)                  # overflow: halve + reset
+    assert s.loss_scale == 4.0
+    s.update_scale(False)
+    assert s.loss_scale == 4.0            # window restarted, not 1/2 in
+    s.update_scale(False)
+    assert s.loss_scale == 8.0            # recovered
+
+
+def test_amp_overflow_event_pairs_scale_halving():
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        s = amp.LossScaler(init_scale=16.0)
+        s.update_scale(True)
+        reg = telemetry.registry()
+        assert reg.counter("amp.overflows").value == 1
+        assert reg.event("amp.overflow").recent[-1] == \
+            {"scale_before": 16.0, "scale_after": 8.0}
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_has_overflow_single_device_get_per_step():
+    """The fused finite check (analysis.numerics.finite_all): one
+    jitted reduction and ONE device_get per has_overflow() call no
+    matter how many gradient arrays -- pinned via the host_sync
+    counter it books its boolean fetch under."""
+    from mxnet_tpu import telemetry
+    s = amp.LossScaler()
+    dirty = [mx.nd.ones((8,)), mx.nd.ones((4, 4)),
+             mx.nd.array(np.array([1.0, np.inf], np.float32))]
+    clean = [mx.nd.ones((8,)), mx.nd.ones((4, 4)), mx.nd.ones((2,))]
+    s.has_overflow(dirty)                 # warm both fused programs
+    s.has_overflow(clean)
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        c = telemetry.registry().counter(
+            "dispatch.host_sync.amp.overflow_check")
+        assert s.has_overflow(dirty)
+        assert c.value == 1               # one sync for 3 arrays
+        assert not s.has_overflow(clean)
+        assert c.value == 2
+        # the sync wall time lands in the host_sync ledger
+        t = telemetry.registry().timer("dispatch.host_sync_time")
+        assert t.count >= 2
+    finally:
+        telemetry.disable()
+        telemetry.reset()
