@@ -108,6 +108,16 @@
 #          whose reconciliation (categories sum to wall within tol)
 #          holds on EVERY window, read input-bound, and emit a
 #          goodput.regression event NAMING input_wait
+#   fleet -> fleet observability gate (docs/observability.md fleet
+#            section, seed 0): a REAL 2-replica supervised serving
+#            fleet discovered through MXNET_TPU_OBS_ENDPOINTS_DIR;
+#            rank 1 is chaos-KILLed mid-flood (serving.dispatch) --
+#            the FleetMonitor's replica_down alert must FIRE naming
+#            rank 1 + generation 0, the supervisor relaunch must
+#            RESOLVE it, every replica that drains reports zero
+#            accepted-request drops, and the `mxtelemetry fleet` CLI
+#            exit codes gate both ways (0 on the healthy relaunched
+#            fleet, 1 once the endpoints are withdrawn)
 #   bench -> bench.py import + dry entry (no device time burned)
 #   wheel -> build a wheel, install into a clean venv, import + smoke
 #
@@ -116,7 +126,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint numlint kernels spmd serving chaos chaos_dist obs bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint numlint kernels spmd serving chaos chaos_dist obs fleet bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -339,6 +349,7 @@ EOF
         tests/test_checkpoint.py tests/test_telemetry.py \
         tests/test_serving.py tests/test_chaos.py tests/test_obs.py \
         tests/test_resilience.py tests/test_numerics.py \
+        tests/test_fleet.py \
         -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
@@ -1317,6 +1328,141 @@ print("obs goodput gate ok: %d windows reconciled, verdict=%r, "
       % (len(wins), last["verdict"]["detail"]))
 EOF
     rm -rf "$obsdir"
+}
+
+run_fleet() {
+    log "fleet: 2-replica kill-mid-flood -> replica_down fires -> relaunch resolves (seed 0)"
+    fdir=$(mktemp -d /tmp/mxtpu_fleet_ci.XXXXXX)
+    cat > "$fdir/replica.py" <<'EOF'
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, gluon, obs, telemetry
+
+workdir = sys.argv[1]
+rank = int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+gen = int(os.environ.get("MXNET_TPU_GENERATION", "0"))
+telemetry.enable()
+chaos.arm_from_spec()            # the kill rule is rank-1 gen-0 scoped
+
+net = gluon.nn.Dense(4)
+net.initialize(); net.hybridize()
+net(mx.nd.array(np.zeros((1, 8), np.float32)))
+reg = mx.serving.ModelRegistry()
+s = reg.register("mlp", block=net, input_shape=(8,),
+                 buckets=(1, 2, 4), max_wait_ms=20, max_queue=256)
+port = obs.serve(0)              # publishes r<rank>.<pid>.json
+print("SERVING rank=%d gen=%d port=%d" % (rank, gen, port), flush=True)
+
+if rank == 1 and gen == 0:
+    # flood only after rank 0 drained: the chaos kill then lands in a
+    # window where rank 0's zero-drop accounting is already banked
+    deadline = time.time() + 120
+    while not os.path.exists(workdir + "/rank0_done"):
+        time.sleep(0.05)
+        assert time.time() < deadline, "rank0_done never appeared"
+
+sample = np.random.RandomState(0).rand(8).astype(np.float32)
+futs = [s.submit(sample, timeout=30) for _ in range(40)]
+for f in futs:                   # every ACCEPTED request must answer
+    assert f.result(timeout=30) is not None
+print("FLOOD_OK rank=%d gen=%d dropped=0" % (rank, gen), flush=True)
+if rank == 0 and gen == 0:
+    open(workdir + "/rank0_done", "w").close()
+# park until the harness says stop; gen-0 survivors instead die by the
+# supervisor's kill-tree when the chaos kill triggers the relaunch
+deadline = time.time() + 300
+while not os.path.exists(workdir + "/stop"):
+    time.sleep(0.1)
+    assert time.time() < deadline, "stop never appeared"
+reg.shutdown(drain=True)
+obs.server.stop()                # withdraws the endpoint file
+print("CLEAN_EXIT rank=%d gen=%d" % (rank, gen), flush=True)
+EOF
+    JAX_PLATFORMS=cpu PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}" \
+        python - "$fdir" <<'EOF' | tee "$fdir/out.log"
+import json, os, subprocess, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from mxnet_tpu import chaos
+from mxnet_tpu.obs.fleet import FleetMonitor
+from mxnet_tpu.supervisor import Supervisor
+
+workdir = sys.argv[1]
+eps = os.path.join(workdir, "eps")
+spec = chaos.make_spec(seed=0, rules=[
+    {"point": "serving.dispatch", "action": "kill", "nth": 5,
+     "rank": 1, "generation": 0}])
+env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TPU_TELEMETRY="1",
+           MXNET_TPU_CHAOS_SPEC=spec)
+sup = Supervisor([sys.executable, "-u", workdir + "/replica.py",
+                  workdir], 2, max_restarts=2, grace_s=3,
+                 env=env, endpoints_dir=eps)
+rc = []
+th = threading.Thread(target=lambda: rc.append(sup.run()), daemon=True)
+th.start()
+
+mon = FleetMonitor(eps, scrape_ms=100, ttl_s=5.0, timeout_s=2.0,
+                   retries=0)
+deadline = time.time() + 240
+# phase 1: the chaos kill must FIRE replica_down naming rank+gen
+fired = None
+while time.time() < deadline and fired is None:
+    mon.poll_once()
+    for a in mon.engine.firing():
+        if a.rule == "replica_down" and "rank 1" in a.reason:
+            fired = a
+    time.sleep(0.1)
+assert fired is not None, "replica_down never fired for rank 1"
+assert "generation 0" in fired.reason, fired.reason
+print("FLEET_FIRED: %s" % fired.reason, flush=True)
+# phase 2: the supervisor relaunch must RESOLVE it
+while time.time() < deadline and mon.engine.firing():
+    mon.poll_once()
+    time.sleep(0.1)
+assert not mon.engine.firing(), \
+    "still firing after relaunch: %r" % mon.engine.firing()
+assert any(h["rule"] == "replica_down" and h["state"] == "resolved"
+           for h in mon.engine.history()), mon.engine.history()
+agg = mon.last["aggregate"]
+assert agg["up"] == 2 and agg["down"] == 0, agg
+gens = {r["rank"]: r["generation"] for r in mon.last["replicas"]}
+assert gens == {0: 1, 1: 1}, gens
+mon.close()
+print("FLEET_RESOLVED: generation 1 up on both ranks", flush=True)
+# gate the CLI exit-code contract both ways: 0 on the healthy
+# relaunched fleet...
+cp = subprocess.run([sys.executable, "-m", "mxnet_tpu.telemetry",
+                     "fleet", eps, "--rounds", "2",
+                     "--interval-ms", "100"],
+                    env=env, capture_output=True, text=True)
+sys.stdout.write(cp.stdout)
+assert cp.returncode == 0, (cp.returncode, cp.stdout, cp.stderr)
+print("FLEET_CLI_HEALTHY_EXIT_0", flush=True)
+open(os.path.join(workdir, "stop"), "w").close()
+th.join(timeout=120)
+assert rc and rc[0] == 0, "supervisor rc %r" % (rc,)
+# ...and 1 once every endpoint is withdrawn (nothing scrapeable)
+cp = subprocess.run([sys.executable, "-m", "mxnet_tpu.telemetry",
+                     "fleet", eps],
+                    env=env, capture_output=True, text=True)
+assert cp.returncode == 1, (cp.returncode, cp.stdout, cp.stderr)
+print("FLEET_CLI_EMPTY_EXIT_1", flush=True)
+print("FLEET_STAGE_OK", flush=True)
+EOF
+    # the gates, re-checked off the transcript: zero-drop floods on
+    # every drained replica, the fire->resolve arc, both CLI exits
+    grep -q "FLOOD_OK rank=0 gen=0 dropped=0" "$fdir/out.log"
+    grep -q "FLOOD_OK rank=0 gen=1 dropped=0" "$fdir/out.log"
+    grep -q "FLOOD_OK rank=1 gen=1 dropped=0" "$fdir/out.log"
+    grep -q "FLEET_FIRED:.*rank 1 generation 0" "$fdir/out.log"
+    grep -q "relaunching generation 1" "$fdir/out.log"
+    grep -q "FLEET_RESOLVED" "$fdir/out.log"
+    [ "$(grep -c "CLEAN_EXIT" "$fdir/out.log")" -eq 2 ]
+    grep -q "FLEET_CLI_HEALTHY_EXIT_0" "$fdir/out.log"
+    grep -q "FLEET_CLI_EMPTY_EXIT_1" "$fdir/out.log"
+    grep -q "FLEET_STAGE_OK" "$fdir/out.log"
+    rm -rf "$fdir"
 }
 
 run_bench() {

@@ -435,6 +435,26 @@ _VARS = [
            "pending region is flushed once it reaches this many ops, "
            "bounding host memory for loops that never sync (reference: "
            "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN)."),
+    EnvVar("MXNET_TPU_OBS_ENDPOINTS_DIR", str, "",
+           "Fleet endpoint-discovery directory (obs.fleet): every obs "
+           "server atomically publishes its {pid, rank, generation, "
+           "port} there on serve() and a FleetMonitor discovers the "
+           "replica set from it.  The supervisor threads this into "
+           "every launched world so relaunched generations "
+           "re-register automatically.  Empty (default) disables "
+           "publication."),
+    EnvVar("MXNET_TPU_OBS_SCRAPE_MS", float, 1000.0,
+           "FleetMonitor scrape interval in milliseconds.  The "
+           "presumed-down TTL defaults to 3x this, so a replica that "
+           "stops answering is declared down within ~3 scrape "
+           "rounds."),
+    EnvVar("MXNET_TPU_OBS_ALERT_RULES", str, "",
+           "JSON list of SLO alert-rule overrides merged onto the "
+           "stock rules by name (obs.alerts.parse_rules): e.g. "
+           "'[{\"name\": \"p99_latency_ms\", \"threshold\": 250}]'.  "
+           "Unparseable specs raise loudly -- a silently-ignored "
+           "alert config is the worst failure mode an alerting plane "
+           "can have."),
 ]
 
 REGISTRY = {v.name: v for v in _VARS}

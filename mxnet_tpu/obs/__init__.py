@@ -23,7 +23,14 @@ a crash-safe flight recorder, and live HTTP introspection.
   checkpoint_stall / recompile / other, reconciled to window wall),
   a rolling MFU gauge, and an EWMA+MAD regression sentinel guarded by
   the env.* health gauges; armed by ``MXNET_TPU_OBS_GOODPUT=1`` /
-  ``obs.enable_goodput()``.
+  ``obs.enable_goodput()``;
+- **fleet plane** (``obs.fleet`` + ``obs.alerts``, ISSUE 17): endpoint
+  discovery via ``MXNET_TPU_OBS_ENDPOINTS_DIR`` (atomic publish,
+  dead-pid sweep), a scrape client + :class:`~mxnet_tpu.obs.fleet.\
+FleetMonitor` aggregating /healthz //metrics //statusz across replicas
+  (merged latency histograms -- never averaged p99s), and a burn-rate
+  SLO :class:`~mxnet_tpu.obs.alerts.AlertEngine` behind ``/alertz``
+  and ``mxtelemetry fleet``.
 
 Tracing is gated exactly like telemetry: disabled (the default), every
 instrumented site pays ONE module-flag check (``obs._TRACE_ENABLED``)
@@ -35,7 +42,7 @@ from __future__ import annotations
 
 import os
 
-from . import flight, goodput, status, trace
+from . import alerts, flight, goodput, status, trace
 from .trace import (TraceContext, begin_span, current, end_span,
                     export_chrome_trace, record_span, span, spans)
 from .trace import trace as start_trace
@@ -46,7 +53,7 @@ __all__ = [
     "start_trace", "span", "begin_span", "end_span", "record_span",
     "current", "spans", "export_chrome_trace", "TraceContext",
     "flight", "goodput", "status", "server", "serve",
-    "install_blackbox",
+    "install_blackbox", "fleet", "alerts",
 ]
 
 # THE flag every traced hot path checks (one module-attribute read).
@@ -104,6 +111,7 @@ def serve(port=None):
 
 
 from . import server  # noqa: E402  (handler imports status above)
+from . import fleet  # noqa: E402  (imports alerts + sync above)
 
 # env arming (same != "0" convention as telemetry)
 if os.environ.get("MXNET_TPU_OBS_TRACE", "0") != "0":

@@ -11,6 +11,14 @@ speaks:
   live telemetry registry (scrape it; no push gateway).
 - ``GET /statusz``  -- the operator JSON: served/published step, swap
   history, bucket occupancy, per-rank last-heartbeat.
+- ``GET /alertz``   -- the fleet alert plane (ISSUE 17): firing/pending
+  alerts, bounded history, and the active rule set, when a
+  :class:`~mxnet_tpu.obs.fleet.FleetMonitor` runs in this process.
+
+When ``MXNET_TPU_OBS_ENDPOINTS_DIR`` is set, :func:`serve` also
+publishes this process's ``{pid, rank, generation, port, started_at}``
+endpoint file there (atomically, via checkpoint-core) so a
+FleetMonitor can discover it; :func:`stop` withdraws it.
 
 Bound to localhost by default (a sidecar/scraper surface, not an
 internet listener); ``port=0`` picks an ephemeral port, returned by
@@ -59,10 +67,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/statusz":
                 self._send(200, json.dumps(_status.statusz(),
                                            default=str))
+            elif path == "/alertz":
+                from . import fleet as _fleet
+                self._send(200, json.dumps(_fleet.alertz(),
+                                           default=str))
             else:
                 self._send(404, json.dumps(
                     {"error": "unknown path %r" % path,
-                     "paths": ["/healthz", "/metrics", "/statusz"]}))
+                     "paths": ["/healthz", "/metrics", "/statusz",
+                               "/alertz"]}))
         except Exception as e:      # an introspection bug must never
             try:                    # kill the serving process
                 self._send(500, json.dumps({"error": str(e)}))
@@ -89,11 +102,15 @@ def serve(port=None, host="127.0.0.1"):
                          name="mxtpu-obs-http")
     t.start()
     _server, _thread = srv, t
-    return srv.server_address[1]
+    bound = srv.server_address[1]
+    from . import fleet as _fleet
+    _fleet.publish_endpoint(bound)   # no-op unless ENDPOINTS_DIR set
+    return bound
 
 
 def stop():
-    """Shut the server down and join its thread."""
+    """Shut the server down, withdraw the published endpoint, and join
+    the thread."""
     global _server, _thread
     srv, _server = _server, None
     t, _thread = _thread, None
@@ -102,6 +119,8 @@ def stop():
         srv.server_close()
     if t is not None:
         t.join(timeout=10)
+    from . import fleet as _fleet
+    _fleet.remove_endpoint()        # the clean-departure path
 
 
 def port():

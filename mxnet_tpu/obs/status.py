@@ -29,20 +29,33 @@ import time
 import weakref
 
 __all__ = ["register_watcher", "register_registry", "register_trainer",
-           "register_ledger", "register_supervisor", "heartbeat",
-           "health", "statusz", "reset"]
+           "register_ledger", "register_supervisor", "register_fleet",
+           "fleet_monitor", "heartbeat", "health", "statusz", "reset",
+           "STATUSZ_SCHEMA"]
+
+# The /statusz contract version (ISSUE 17).  The fleet scrape client
+# refuses to parse any other value -- bump on incompatible change.
+STATUSZ_SCHEMA = "mxstatusz.v1"
 
 _watchers = weakref.WeakSet()
 _registries = weakref.WeakSet()
 _trainers = weakref.WeakSet()
 _ledgers = weakref.WeakSet()    # goodput StepLedgers (obs.goodput)
 _supervisors = weakref.WeakSet()   # elastic restart supervisors
+_fleet = weakref.WeakSet()      # FleetMonitors (obs.fleet)
 _heartbeats = {}                # rank -> wall time of last beat
 
 
 def _rank():
     try:
         return int(os.environ.get("MXNET_TPU_PROC_ID", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def _generation():
+    try:
+        return int(os.environ.get("MXNET_TPU_GENERATION", "0") or 0)
     except ValueError:
         return 0
 
@@ -67,6 +80,19 @@ def register_supervisor(supervisor):
     _supervisors.add(supervisor)
 
 
+def register_fleet(monitor):
+    _fleet.add(monitor)
+
+
+def fleet_monitor():
+    """The newest registered FleetMonitor (``/alertz`` reads it), or
+    None when this process runs no fleet plane."""
+    best = None
+    for m in list(_fleet):
+        best = m
+    return best
+
+
 def heartbeat(rank=None):
     """One liveness beat (the trainer loop calls this every step)."""
     _heartbeats[_rank() if rank is None else int(rank)] = time.time()
@@ -79,6 +105,7 @@ def reset():
     _trainers.clear()
     _ledgers.clear()
     _supervisors.clear()
+    _fleet.clear()
     _heartbeats.clear()
 
 
@@ -181,14 +208,23 @@ def statusz():
         numerics_row = _numerics.status_row()
     except Exception:
         numerics_row = None
+    fleet_row = None
+    mon = fleet_monitor()
+    if mon is not None:
+        try:
+            fleet_row = mon.fleet_row()
+        except Exception:
+            fleet_row = None
     swap_ev = reg.get("serving.swap")
     occupancy = reg.get("serving.batch_occupancy")
     served = reg.get("serving.served_step")
     published = reg.get("train_loop.published_step")
     ready, reasons = health()
     return {
+        "schema": STATUSZ_SCHEMA,
         "pid": os.getpid(),
         "rank": _rank(),
+        "generation": _generation(),
         "time": time.time(),
         "ready": ready,
         "not_ready_reasons": reasons,
@@ -207,4 +243,7 @@ def statusz():
         # seen, last attribution (analysis.numerics, docs/numerics.md)
         "numerics": numerics_row,
         "heartbeats": dict(_heartbeats),
+        # replicas up/down + firing-alert count when a FleetMonitor
+        # runs here (obs.fleet, ISSUE 17)
+        "fleet": fleet_row,
     }

@@ -73,6 +73,10 @@ def _detect():
         # goodput ledger (mx.obs.goodput): LIVE arm state of the
         # per-window step-time attribution + regression sentinel
         "OBS_GOODPUT": _obs_goodput(),
+        # fleet observability plane (mx.obs.fleet): whether this
+        # process publishes a discovery endpoint or runs a
+        # FleetMonitor (MXNET_TPU_OBS_ENDPOINTS_DIR or a live monitor)
+        "FLEET": _fleet_active(),
     }
     return {k: Feature(k, bool(v)) for k, v in feats.items()}
 
@@ -90,6 +94,11 @@ def _obs_tracing():
 def _obs_goodput():
     from . import obs
     return obs.goodput_enabled()
+
+
+def _fleet_active():
+    from .obs import fleet
+    return fleet.active()
 
 
 def _tsan_enabled():

@@ -203,6 +203,8 @@ class DynamicBatcher:
             t_call = time.perf_counter()
             outs = jax.device_get(outs)       # one gather for the batch
         except Exception as e:                # compiled call failed:
+            if _telemetry._ENABLED:           # the fleet error_ratio
+                _telemetry.hooks.serving_error(self._label)
             for r in reqs:                    # fail the REQUESTS, keep
                 r.future.set_exception(e)     # the worker alive
             return

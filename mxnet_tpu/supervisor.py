@@ -89,7 +89,7 @@ class Supervisor:
     """
 
     def __init__(self, command, num_workers, max_restarts=None,
-                 grace_s=None, env=None):
+                 grace_s=None, env=None, endpoints_dir=None):
         from . import env as _env
         if num_workers < 1:
             raise MXNetError("Supervisor: num_workers must be >= 1")
@@ -101,6 +101,12 @@ class Supervisor:
         self.grace_s = float(grace_s if grace_s is not None
                              else _env.get("MXNET_TPU_SUPERVISOR_GRACE_S"))
         self._base_env = dict(os.environ if env is None else env)
+        # the fleet discovery dir (obs.fleet, ISSUE 17): threaded into
+        # every launched world so a relaunched generation's obs server
+        # re-registers under the same rank automatically
+        self.endpoints_dir = (
+            endpoints_dir if endpoints_dir is not None
+            else self._base_env.get("MXNET_TPU_OBS_ENDPOINTS_DIR", ""))
         self.generation = int(
             self._base_env.get("MXNET_TPU_GENERATION", "0") or 0)
         self.restarts = 0
@@ -154,7 +160,10 @@ class Supervisor:
         with _print_lock:
             print("supervisor: " + msg, flush=True)
 
-    def _spawn(self, gen, rank, coord):
+    def _worker_env(self, gen, rank, coord):
+        """The env one launched rank runs under (factored out of
+        _spawn so the threading contract is testable without
+        launching)."""
         env = dict(self._base_env)
         env.update({
             "MXNET_TPU_COORDINATOR": coord,
@@ -162,7 +171,13 @@ class Supervisor:
             "MXNET_TPU_PROC_ID": str(rank),
             "MXNET_TPU_GENERATION": str(gen),
         })
-        p = subprocess.Popen(self.command, env=env,
+        if self.endpoints_dir:
+            env["MXNET_TPU_OBS_ENDPOINTS_DIR"] = self.endpoints_dir
+        return env
+
+    def _spawn(self, gen, rank, coord):
+        p = subprocess.Popen(self.command,
+                             env=self._worker_env(gen, rank, coord),
                              start_new_session=True,
                              stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)

@@ -10,11 +10,14 @@ Subcommands:
   -- the first skew instrument multi-host SPMD has.
 - ``blackbox crash.bbox`` -- render a flight-recorder ring
   (``mx.obs.flight``): the final records before the process died.
+- ``fleet <endpoints-dir | url...>`` -- scrape the live fleet
+  (``mx.obs.fleet``): the per-replica table, pooled SLO aggregates,
+  and the alert engine's firing/pending/history view.
 
 Contract mirrors the mxlint CLI (``mxnet_tpu.analysis.cli``): exit 0 on
 success with ``--json`` for machine-readable output, exit 1 when the log
-is missing/empty (nothing to summarize is a failed gate in CI), exit 2
-on usage errors.
+is missing/empty (nothing to summarize is a failed gate in CI) or --
+for ``fleet`` -- while ANY alert fires, exit 2 on usage errors.
 """
 from __future__ import annotations
 
@@ -74,6 +77,21 @@ def _build_parser():
     bb.add_argument("--last", type=int, default=40,
                     help="records to show in the human rendering "
                          "(default 40)")
+    fl = sub.add_parser("fleet",
+                        help="scrape and render the live fleet "
+                             "(mx.obs.fleet / "
+                             "MXNET_TPU_OBS_ENDPOINTS_DIR)")
+    fl.add_argument("source", nargs="+", metavar="dir-or-url",
+                    help="ONE endpoints directory, or one or more "
+                         "http:// replica base URLs")
+    fl.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable fleet snapshot + alerts")
+    fl.add_argument("--rounds", type=int, default=2,
+                    help="scrape rounds before rendering (>= 2 so "
+                         "rate/ratio deltas exist; default 2)")
+    fl.add_argument("--interval-ms", type=float, default=None,
+                    help="inter-round interval (default "
+                         "MXNET_TPU_OBS_SCRAPE_MS)")
     return ap
 
 
@@ -592,11 +610,59 @@ def _main_blackbox(args):
     return 0
 
 
+def _main_fleet(args):
+    """``mxtelemetry fleet``: poll the fleet ``--rounds`` times and
+    render the table + alerts.  Exit 0 healthy, 1 while ANY alert
+    fires (the pageable condition -- same contract as the mxlint
+    gate) or when nothing was scrapeable, 2 on usage errors."""
+    import os as _os
+    import time as _time
+    from ..obs.fleet import FleetMonitor
+    dirs = [s for s in args.source if not s.startswith("http")]
+    urls = [s for s in args.source if s.startswith("http")]
+    if dirs and urls:
+        print("fleet: mixing an endpoints dir and URLs is ambiguous; "
+              "pass one or the other", file=sys.stderr)
+        return 2
+    if len(dirs) > 1:
+        print("fleet: exactly one endpoints directory", file=sys.stderr)
+        return 2
+    if dirs and not _os.path.isdir(dirs[0]):
+        print("fleet: %s is not a directory" % dirs[0], file=sys.stderr)
+        return 2
+    mon = FleetMonitor(dirs[0] if dirs else urls,
+                       scrape_ms=args.interval_ms)
+    try:
+        rounds = max(int(args.rounds), 1)
+        for i in range(rounds):
+            if i:
+                _time.sleep(mon.scrape_s)
+            snap = mon.poll_once()
+        if args.as_json:
+            print(json.dumps({"fleet": snap,
+                              "alerts": mon.engine.alertz()},
+                             indent=2, sort_keys=True, default=str))
+        else:
+            print(mon.table())
+        if mon.engine.firing():
+            return 1
+        if not any(r["state"] in ("ok", "init")
+                   for r in snap["replicas"]):
+            print("fleet: no scrapeable replica in %s"
+                  % " ".join(args.source), file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        mon.close()
+
+
 def main(argv=None) -> int:
     ap = _build_parser()
     args = ap.parse_args(argv)
     if args.cmd == "blackbox":
         return _main_blackbox(args)
+    if args.cmd == "fleet":
+        return _main_fleet(args)
     if args.cmd != "summarize":
         ap.print_usage()
         return 2

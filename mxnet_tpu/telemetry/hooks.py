@@ -40,6 +40,8 @@ __all__ = [
     "goodput_window", "goodput_regression", "goodput_env_degraded",
     "dist_rank_failure", "checkpoint_commit_aborted",
     "supervisor_restart", "supervisor_exhausted",
+    "serving_error", "fleet_scrape", "fleet_replica_down",
+    "fleet_round", "fleet_alert", "fleet_alerts_firing",
 ]
 
 
@@ -246,6 +248,13 @@ def serving_shed(model):
 
 def serving_timeout(model):
     _registry().counter("serving.timeouts").inc()
+
+
+def serving_error(model):
+    """A compiled dispatch raised: the batch's requests were failed but
+    the worker survived -- the error_ratio numerator the fleet plane
+    scrapes."""
+    _registry().counter("serving.errors").inc()
 
 
 def serving_batch(model, occupancy, bucket, seconds):
@@ -455,6 +464,58 @@ def goodput_env_degraded(window, dispatch_roundtrip_us):
     reg.counter("goodput.env_degraded_windows").inc()
     reg.event("goodput.env_degraded").emit(
         window=window, dispatch_roundtrip_us=dispatch_roundtrip_us)
+
+
+def fleet_scrape(ok):
+    """One replica scrape attempt by a FleetMonitor finished."""
+    reg = _registry()
+    reg.counter("fleet.scrapes").inc()
+    if not ok:
+        reg.counter("fleet.scrape_failures").inc()
+
+
+def fleet_replica_down(rank, generation, error):
+    """A replica flipped to presumed-down (dead pid, stale past TTL,
+    or scrape failures outliving the lease) -- the event NAMES the
+    rank and generation so the page is actionable."""
+    reg = _registry()
+    reg.counter("fleet.replica_downs").inc()
+    reg.event("fleet.replica_down").emit(rank=rank,
+                                         generation=generation,
+                                         error=error)
+
+
+def fleet_round(agg):
+    """One fleet aggregation round: publish the pooled view as gauges
+    (obs.fleet.FleetMonitor)."""
+    reg = _registry()
+    reg.gauge("fleet.replicas").set(agg["replicas"])
+    reg.gauge("fleet.replicas_down").set(agg["down"])
+    if agg.get("qps") is not None:
+        reg.gauge("fleet.qps").set(agg["qps"])
+    reg.gauge("fleet.queue_depth").set(agg["queue_depth"])
+    if agg.get("shed_ratio") is not None:
+        reg.gauge("fleet.shed_ratio").set(agg["shed_ratio"])
+    if agg.get("error_ratio") is not None:
+        reg.gauge("fleet.error_ratio").set(agg["error_ratio"])
+    lat = agg.get("latency_ms") or {}
+    for q in ("p50", "p95", "p99"):
+        if lat.get(q) is not None:
+            reg.gauge("fleet.latency_%s_ms" % q).set(lat[q])
+    skew = (agg.get("served_step") or {}).get("skew")
+    if skew is not None:
+        reg.gauge("fleet.served_step_skew").set(skew)
+
+
+def fleet_alert(rule, state, reason, value):
+    """One alert state transition (obs.alerts.AlertEngine)."""
+    _registry().event("fleet.alert").emit(rule=rule, state=state,
+                                          reason=reason, value=value)
+
+
+def fleet_alerts_firing(n):
+    """Currently-firing alert count (the pageable surface)."""
+    _registry().gauge("fleet.alerts_firing").set(n)
 
 
 def env_health(dispatch_roundtrip_us, h2d_mb_per_s=None):
@@ -757,6 +818,43 @@ INSTRUMENTS = [
     _ii("supervisor.exhausted", "event", "supervisor", 15,
         "the terminal budget exhaustion; payload carries generation + "
         "budget -- alert on this"),
+    _ii("serving.errors", "counter", "serving", 17,
+        "compiled dispatches that raised (requests failed, worker "
+        "survived) -- the fleet error_ratio numerator"),
+    _ii("fleet.scrapes", "counter", "fleet", 17,
+        "replica scrape attempts by a FleetMonitor"),
+    _ii("fleet.scrape_failures", "counter", "fleet", 17,
+        "scrape attempts that failed every retry"),
+    _ii("fleet.replicas", "gauge", "fleet", 17,
+        "replicas currently tracked by the monitor"),
+    _ii("fleet.replicas_down", "gauge", "fleet", 17,
+        "replicas presumed down (dead pid / stale past TTL)"),
+    _ii("fleet.replica_downs", "counter", "fleet", 17,
+        "down transitions observed"),
+    _ii("fleet.replica_down", "event", "fleet", 17,
+        "one down transition; payload NAMES rank + generation + the "
+        "last scrape error"),
+    _ii("fleet.qps", "gauge", "fleet", 17,
+        "pooled accepted-request rate over the rolling window"),
+    _ii("fleet.queue_depth", "gauge", "fleet", 17,
+        "summed request-queue depth across up replicas"),
+    _ii("fleet.shed_ratio", "gauge", "fleet", 17,
+        "shed / (accepted + shed) over the rolling window"),
+    _ii("fleet.error_ratio", "gauge", "fleet", 17,
+        "(errors + timeouts) / responses over the rolling window"),
+    _ii("fleet.latency_<q>_ms", "gauge", "fleet", 17,
+        "fleet latency percentile (p50/p95/p99) from MERGED Timer "
+        "histogram buckets across replicas -- never an average of "
+        "per-replica percentiles"),
+    _ii("fleet.served_step_skew", "gauge", "fleet", 17,
+        "max - min served checkpoint step across up replicas"),
+    _ii("fleet.alerts_firing", "gauge", "fleet", 17,
+        "currently-firing SLO alerts (page while > 0; mxtelemetry "
+        "fleet exits 1)"),
+    _ii("fleet.alert", "event", "fleet", 17,
+        "one alert state transition (pending/firing/resolved/"
+        "cancelled); payload carries rule + reason naming the "
+        "replica"),
     _ii("env.dispatch_roundtrip_us", "gauge", "bench", 13,
         "bench env-health dispatch round trip (the degraded_env "
         "basis)"),

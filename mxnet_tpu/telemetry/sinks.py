@@ -115,6 +115,12 @@ def prom_text(snapshot):
                 acc += n
                 lines.append('%s_bucket{le="%s"} %d' % (base, le, acc))
             lines.append('%s_bucket{le="+Inf"} %d' % (base, rec["count"]))
+            # quantile series off the histogram estimator (ISSUE 17):
+            # scrapers get p50/p95/p99 without replaying the buckets
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                v = rec.get(key)
+                if v is not None:
+                    lines.append('%s{quantile="%s"} %s' % (base, q, v))
         elif kind == "event":
             lines.append("# TYPE %s counter" % base)
             lines.append("%s %s" % (base, rec["count"]))

@@ -1,0 +1,958 @@
+"""Fleet observability plane tests (ISSUE 17): endpoint discovery
+(atomic publish, dead-pid sweep, generation replacement), the scrape
+client's failure modes (refused, mid-read death, garbage JSON, wrong
+schema, hang), histogram-merge percentile correctness (and the proof
+that averaging p99s is wrong), the burn-rate alert state machine
+(fast AND slow to fire, sustained recovery to resolve, holddown-
+bounded flapping), FleetMonitor aggregation + down/back transitions
+over fake replicas, /alertz + the statusz fleet row, the Features
+FLEET flip, and the `mxtelemetry fleet` exit-code contract."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import obs, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.obs import alerts, fleet
+from mxnet_tpu.obs.fleet import (FleetMonitor, MergedHistogram,
+                                 SchemaMismatch, ScrapeError)
+from mxnet_tpu.telemetry import cli as tcli
+from mxnet_tpu.telemetry.core import _TIMER_BUCKETS
+from mxnet_tpu.telemetry.sinks import prom_text
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet(monkeypatch):
+    """Fleet state is process-global by design (published endpoints,
+    live monitors, the obs server singleton): start and end clean."""
+    monkeypatch.delenv("MXNET_TPU_OBS_ENDPOINTS_DIR", raising=False)
+    telemetry.disable()
+    telemetry.registry().clear()
+    obs.status.reset()
+    fleet._published.clear()
+    for m in list(fleet._monitors):
+        m.close()
+    yield
+    for m in list(fleet._monitors):
+        try:
+            m.close()
+        except Exception:
+            pass
+    fleet._published.clear()
+    obs.server.stop()
+    obs.status.reset()
+    telemetry.disable()
+    telemetry.registry().clear()
+
+
+def _bucketize(samples):
+    """{le-string: n} per-bucket counts the way Timer.snapshot lays
+    them out."""
+    import bisect
+    out = {}
+    for s in samples:
+        idx = min(bisect.bisect_left(_TIMER_BUCKETS, s),
+                  len(_TIMER_BUCKETS) - 1)
+        key = "%g" % _TIMER_BUCKETS[idx]
+        out[key] = out.get(key, 0) + 1
+    return out
+
+
+class _FakeReplica:
+    """A minimal obs-server stand-in with scriptable failure modes, so
+    one test process can host a whole fleet."""
+
+    def __init__(self, rank=0, generation=0, pid=None):
+        self.rank = rank
+        self.generation = generation
+        self.pid = os.getpid() if pid is None else pid
+        self.schema = "mxstatusz.v1"
+        self.mode = "ok"     # ok|garbage|wrong_schema|partial|hang
+        self.ready = True
+        self.requests = 0
+        self.responses = 0
+        self.shed = 0
+        self.errors = 0
+        self.timeouts = 0
+        self.served_step = 0
+        self.queue_depth = 0
+        self.latency = {}            # per-bucket {le-string: n}
+        self.per_scrape = None       # called before each /metrics
+        rep = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send(self, code, body, ctype="application/json"):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if rep.mode == "hang":
+                    time.sleep(3.0)
+                    return
+                if rep.mode == "partial":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "4096")
+                    self.end_headers()
+                    self.wfile.write(b'{"truncated')
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                if self.path == "/healthz":
+                    self._send(200 if rep.ready else 503, json.dumps(
+                        {"status": "READY" if rep.ready
+                         else "NOT_READY", "reasons": []}))
+                elif self.path == "/statusz":
+                    if rep.mode == "garbage":
+                        self._send(200, "{definitely not json")
+                    else:
+                        self._send(200, json.dumps(rep.statusz()))
+                elif self.path == "/metrics":
+                    if rep.per_scrape is not None:
+                        rep.per_scrape(rep)
+                    self._send(200, rep.metrics_text(),
+                               ctype="text/plain")
+                else:
+                    self._send(404, "{}")
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.srv.daemon_threads = True
+        self.port = self.srv.server_address[1]
+        self.url = "http://127.0.0.1:%d" % self.port
+        self._thread = threading.Thread(target=self.srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def add_latency(self, seconds, n=1):
+        for key, cnt in _bucketize([seconds] * n).items():
+            self.latency[key] = self.latency.get(key, 0) + cnt
+
+    def statusz(self):
+        return {
+            "schema": ("bogus.v9" if self.mode == "wrong_schema"
+                       else self.schema),
+            "pid": self.pid, "rank": self.rank,
+            "generation": self.generation, "ready": self.ready,
+            "served_step": self.served_step, "published_step": None,
+            "servables": [{"name": "m", "queue_depth": self.queue_depth,
+                           "queue_capacity": 64}],
+            "goodput": None,
+        }
+
+    def metrics_text(self):
+        count = sum(self.latency.values())
+        snap = [
+            {"kind": "counter", "name": "serving.requests",
+             "value": self.requests},
+            {"kind": "counter", "name": "serving.responses",
+             "value": self.responses},
+            {"kind": "counter", "name": "serving.shed",
+             "value": self.shed},
+            {"kind": "counter", "name": "serving.errors",
+             "value": self.errors},
+            {"kind": "counter", "name": "serving.timeouts",
+             "value": self.timeouts},
+            {"kind": "timer", "name": "serving.latency",
+             "count": count, "sum": 0.0, "buckets": dict(self.latency)},
+        ]
+        return prom_text(snap)
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        self._thread.join(timeout=10)
+
+
+# ---------------------------------------------------------------------
+# endpoint discovery contract
+# ---------------------------------------------------------------------
+
+def test_publish_discover_remove_roundtrip(tmp_path):
+    d = str(tmp_path)
+    path = fleet.publish_endpoint(4242, dirpath=d, rank=3, generation=7)
+    assert os.path.basename(path) == "r3.%d.json" % os.getpid()
+    eps = fleet.discover(d)
+    assert len(eps) == 1
+    ep = eps[0]
+    assert (ep.rank, ep.generation, ep.port, ep.pid) \
+        == (3, 7, 4242, os.getpid())
+    assert ep.url == "http://127.0.0.1:4242"
+    fleet.remove_endpoint(path)
+    assert fleet.discover(d) == []
+    assert path not in fleet._published
+
+
+def test_publish_is_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_OBS_ENDPOINTS_DIR", raising=False)
+    assert fleet.publish_endpoint(1234) is None
+    assert fleet._published == []
+
+
+def test_discover_skips_garbage_and_foreign_files(tmp_path):
+    d = str(tmp_path)
+    fleet.publish_endpoint(1111, dirpath=d, rank=0, generation=0)
+    (tmp_path / "r1.99999.json").write_text("{torn")   # garbage body
+    (tmp_path / "README.txt").write_text("not an endpoint")
+    eps = fleet.discover(d)
+    assert [e.rank for e in eps] == [0]
+
+
+def test_newest_generation_wins_per_rank(tmp_path):
+    d = str(tmp_path)
+    # a relaunched rank 0: old generation's file still present
+    (tmp_path / ("r0.%d.json" % os.getpid())).write_text(json.dumps(
+        {"pid": os.getpid(), "rank": 0, "generation": 0, "port": 1000,
+         "started_at": 1.0}))
+    (tmp_path / ("r0.%d.json" % (os.getpid() + 1))).write_text(
+        json.dumps({"pid": os.getpid() + 1, "rank": 0, "generation": 1,
+                    "port": 2000, "started_at": 2.0}))
+    eps = fleet.discover(d)
+    assert len(eps) == 1
+    assert (eps[0].generation, eps[0].port) == (1, 2000)
+
+
+def test_sweep_removes_dead_pid_endpoints_only(tmp_path):
+    d = str(tmp_path)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    dead = p.pid
+    live = fleet.publish_endpoint(2222, dirpath=d, rank=0, generation=0)
+    (tmp_path / ("r1.%d.json" % dead)).write_text(json.dumps(
+        {"pid": dead, "rank": 1, "generation": 0, "port": 1,
+         "started_at": 0.0}))
+    removed = fleet.sweep_endpoints(d)
+    assert [os.path.basename(r) for r in removed] \
+        == ["r1.%d.json" % dead]
+    assert os.path.exists(live)
+
+
+def test_serve_publishes_and_stop_withdraws(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_TPU_OBS_ENDPOINTS_DIR", d)
+    port = obs.serve(0)
+    eps = fleet.discover(d)
+    assert len(eps) == 1 and eps[0].port == port
+    obs.server.stop()
+    assert fleet.discover(d) == []
+
+
+# ---------------------------------------------------------------------
+# scrape client
+# ---------------------------------------------------------------------
+
+def test_scrape_happy_path_typed_snapshot():
+    rep = _FakeReplica(rank=2, generation=1)
+    try:
+        rep.requests = 10
+        rep.shed = 3
+        rep.served_step = 40
+        rep.queue_depth = 5
+        rep.add_latency(0.010, n=4)
+        snap = fleet.scrape(rep.url, timeout_s=2.0)
+        assert snap.rank == 2 and snap.generation == 1
+        assert snap.ready is True
+        assert snap.served_step == 40
+        assert snap.queue_depth == 5
+        assert snap.counters["requests"] == 10.0
+        assert snap.counters["shed"] == 3.0
+        # prom buckets come back cumulative with a +Inf entry
+        assert snap.latency[float("inf")] == 4
+    finally:
+        rep.close()
+
+
+def test_scrape_not_ready_healthz_is_an_answer():
+    rep = _FakeReplica()
+    try:
+        rep.ready = False
+        snap = fleet.scrape(rep.url, timeout_s=2.0)
+        assert snap.ready is False
+    finally:
+        rep.close()
+
+
+def test_scrape_connection_refused_raises_scrape_error():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    with pytest.raises(ScrapeError):
+        fleet.scrape("http://127.0.0.1:%d" % port, timeout_s=0.5)
+
+
+def test_scrape_garbage_json_raises_scrape_error():
+    rep = _FakeReplica()
+    try:
+        rep.mode = "garbage"
+        with pytest.raises(ScrapeError):
+            fleet.scrape(rep.url, timeout_s=2.0)
+    finally:
+        rep.close()
+
+
+def test_scrape_mid_read_death_raises_scrape_error():
+    rep = _FakeReplica()
+    try:
+        rep.mode = "partial"
+        with pytest.raises(ScrapeError):
+            fleet.scrape(rep.url, timeout_s=2.0)
+    finally:
+        rep.close()
+
+
+def test_scrape_hang_bounded_by_timeout():
+    rep = _FakeReplica()
+    try:
+        rep.mode = "hang"
+        t0 = time.monotonic()
+        with pytest.raises(ScrapeError):
+            fleet.scrape(rep.url, timeout_s=0.3)
+        assert time.monotonic() - t0 < 2.5
+    finally:
+        rep.mode = "ok"
+        rep.close()
+
+
+def test_scrape_rejects_unknown_schema_loudly():
+    rep = _FakeReplica()
+    try:
+        rep.mode = "wrong_schema"
+        with pytest.raises(SchemaMismatch) as ei:
+            fleet.scrape(rep.url, timeout_s=2.0)
+        assert "bogus.v9" in str(ei.value)
+        assert "mxstatusz.v1" in str(ei.value)
+    finally:
+        rep.close()
+
+
+def test_statusz_carries_schema_rank_generation(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_PROC_ID", "3")
+    monkeypatch.setenv("MXNET_TPU_GENERATION", "2")
+    sz = obs.status.statusz()
+    assert sz["schema"] == "mxstatusz.v1"
+    assert sz["rank"] == 3
+    assert sz["generation"] == 2
+
+
+def test_prom_text_emits_timer_quantile_series():
+    telemetry.enable()
+    t = telemetry.registry().timer("serving.latency")
+    for _ in range(100):
+        t.observe(0.004)
+    text = prom_text(telemetry.registry().snapshot())
+    assert 'mxnet_tpu_serving_latency{quantile="0.5"}' in text
+    assert 'mxnet_tpu_serving_latency{quantile="0.99"}' in text
+    # and the quantile lines carry the estimator's values
+    values, buckets = fleet._parse_prom(text)
+    assert buckets["mxnet_tpu_serving_latency"][float("inf")] == 100
+
+
+# ---------------------------------------------------------------------
+# histogram merge -- NEVER average percentiles
+# ---------------------------------------------------------------------
+
+def _exact_percentile(samples, q):
+    samples = sorted(samples)
+    n = len(samples)
+    return samples[min(n - 1, max(0, int(round(q * n)) - 1))]
+
+
+def test_merged_percentile_matches_pooled_within_estimator_bound():
+    # replica A: 1000 fast requests (~1ms); replica B: 20 slow (~1s)
+    a = [0.001 * (1 + 0.3 * ((i * 7) % 10) / 10.0) for i in range(1000)]
+    b = [1.0 * (1 + 0.1 * ((i * 3) % 10) / 10.0) for i in range(20)]
+    hist = MergedHistogram()
+    hist.add_buckets(_bucketize(a))
+    hist.add_buckets(_bucketize(b))
+    assert hist.count == 1020
+    pooled = a + b
+    for q in (0.5, 0.95, 0.99):
+        exact = _exact_percentile(pooled, q)
+        est = hist.percentile(q)
+        # the estimator returns the bucket's upper bound: correct
+        # within one power-of-2 bucket
+        assert exact <= est <= 2.01 * exact, (q, exact, est)
+
+
+def test_averaged_p99_would_be_wrong():
+    a = [0.001] * 1000
+    b = [1.0] * 20
+    ha, hb = MergedHistogram(), MergedHistogram()
+    ha.add_buckets(_bucketize(a))
+    hb.add_buckets(_bucketize(b))
+    merged = MergedHistogram().merge(ha).merge(hb)
+    # pooled p99: rank 1009.8 of 1020 lands in the slow tail
+    exact = _exact_percentile(a + b, 0.99)
+    assert exact == 1.0
+    assert merged.percentile(0.99) >= 1.0
+    # the average of per-replica p99s splits the difference -- off by
+    # ~500x from the fast replica's truth and 2x from the pooled one
+    averaged = (ha.percentile(0.99) + hb.percentile(0.99)) / 2.0
+    assert averaged > 2.01 * 0.001          # nowhere near replica A
+    assert not (exact <= averaged <= 2.01 * exact)  # outside the bound
+    # while the merged estimator stays inside it
+    assert exact <= merged.percentile(0.99) <= 2.01 * exact
+
+
+def test_cumulative_to_per_bucket_and_delta():
+    cum = {0.001: 5, 0.002: 9, float("inf"): 10}
+    per = fleet._per_bucket(cum)
+    assert per == {0.001: 5, 0.002: 4, float("inf"): 1}
+    later = {0.001: 7, 0.002: 12, float("inf"): 14}
+    delta = fleet._delta_hist(later, cum)
+    # per-bucket diffs: (7-5), (5-4), (2-1)
+    assert delta == {0.001: 2, 0.002: 1, float("inf"): 1}
+    h = MergedHistogram()
+    h.add_cumulative(cum)
+    assert h.count == 10
+
+
+# ---------------------------------------------------------------------
+# alert state machine
+# ---------------------------------------------------------------------
+
+def test_rule_validation():
+    with pytest.raises(MXNetError):
+        alerts.Rule("x", threshold=1.0, metric="not_a_metric")
+    with pytest.raises(MXNetError):
+        alerts.Rule("p99_latency_ms", threshold=1.0, fast_s=60,
+                    slow_s=30)
+
+
+def test_parse_rules_defaults_overrides_and_loud_failures(monkeypatch):
+    names = {r.name for r in alerts.parse_rules("")}
+    assert names == {"p99_latency_ms", "shed_ratio", "error_ratio",
+                     "replica_down"}
+    rules = {r.name: r for r in alerts.parse_rules(
+        '[{"name": "p99_latency_ms", "threshold": 250}]')}
+    assert rules["p99_latency_ms"].threshold == 250.0
+    assert rules["shed_ratio"].threshold == 0.05    # untouched default
+    with pytest.raises(MXNetError):
+        alerts.parse_rules("{not json")
+    with pytest.raises(MXNetError):
+        alerts.parse_rules('{"name": "x"}')         # not a list
+    with pytest.raises(MXNetError):
+        alerts.parse_rules('[{"threshold": 1}]')    # no name
+    with pytest.raises(MXNetError):
+        alerts.parse_rules('[{"name": "p99_latency_ms", "bogus": 1}]')
+    with pytest.raises(MXNetError):
+        alerts.parse_rules('[{"name": "brand_new"}]')  # no threshold
+    monkeypatch.setenv("MXNET_TPU_OBS_ALERT_RULES",
+                       '[{"name": "shed_ratio", "threshold": 0.5}]')
+    rules = {r.name: r for r in alerts.parse_rules()}
+    assert rules["shed_ratio"].threshold == 0.5
+
+
+def _engine(**kw):
+    rule = alerts.Rule("p99_latency_ms", threshold=100.0, fast_s=30.0,
+                       slow_s=300.0, fast_burn=0.5, slow_burn=0.5,
+                       resolve_s=60.0, holddown_s=120.0, **kw)
+    return alerts.AlertEngine(rules=[rule]), rule
+
+
+def test_firing_requires_fast_and_slow_windows():
+    eng, _ = _engine()
+    t = 1000.0
+    # 10 minutes of clean history, one observation per 10s
+    for i in range(60):
+        eng.observe({"p99_latency_ms": 10.0}, now=t + 10 * i)
+    t2 = t + 600
+    # 30s of breaches: fast window saturates, slow window is still
+    # diluted by the clean history -> pending, NOT firing
+    changed = []
+    for i in range(4):
+        changed += eng.observe({"p99_latency_ms": 900.0},
+                               now=t2 + 10 * i)
+    states = [a.state for a in eng.active()]
+    assert states == ["pending"]
+    assert all(a.state == "pending" for a in changed)
+    # keep breaching until the slow window burns too -> fires
+    fired = None
+    for i in range(4, 40):
+        for a in eng.observe({"p99_latency_ms": 900.0},
+                             now=t2 + 10 * i):
+            if a.state == "firing":
+                fired = a
+    assert fired is not None
+    assert "p99_latency_ms" in fired.reason
+    assert eng.firing()[0] is fired
+
+
+def test_blip_cancels_without_paging():
+    eng, _ = _engine()
+    t = 1000.0
+    for i in range(60):
+        eng.observe({"p99_latency_ms": 10.0}, now=t + 10 * i)
+    t2 = t + 600
+    # a 20s blip: enough to burn the fast window and open pending...
+    eng.observe({"p99_latency_ms": 900.0}, now=t2)
+    eng.observe({"p99_latency_ms": 900.0}, now=t2 + 10)
+    assert [a.state for a in eng.active()] == ["pending"]
+    # ...but it clears before the slow window burns -> cancelled
+    changed = []
+    for i in range(2, 9):
+        changed += eng.observe({"p99_latency_ms": 10.0},
+                               now=t2 + 10 * i)
+    assert eng.active() == []
+    assert any(a.state == "cancelled" for a in changed)
+    assert eng.history()[-1]["state"] == "cancelled"
+    assert eng.firing() == []
+
+
+def test_resolve_requires_sustained_recovery():
+    eng, rule = _engine()
+    t = 1000.0
+    for i in range(40):
+        eng.observe({"p99_latency_ms": 900.0}, now=t + 10 * i)
+    assert [a.state for a in eng.firing()] == ["firing"]
+    t2 = t + 400
+    # 30s clean < resolve_s (60): still firing
+    for i in range(4):
+        eng.observe({"p99_latency_ms": 10.0}, now=t2 + 10 * i)
+    assert eng.firing() != []
+    # sustained recovery past resolve_s -> resolved
+    resolved = []
+    for i in range(4, 12):
+        resolved += [a for a in eng.observe({"p99_latency_ms": 10.0},
+                                            now=t2 + 10 * i)
+                     if a.state == "resolved"]
+    assert len(resolved) == 1
+    assert "recovered" in resolved[0].reason
+    assert eng.firing() == [] and eng.active() == []
+    assert eng.history()[-1]["state"] == "resolved"
+
+
+def test_holddown_bounds_flapping():
+    eng, rule = _engine()
+    t = 1000.0
+    for i in range(40):
+        eng.observe({"p99_latency_ms": 900.0}, now=t + 10 * i)
+    t2 = t + 400
+    for i in range(12):
+        eng.observe({"p99_latency_ms": 10.0}, now=t2 + 10 * i)
+    assert eng.active() == []           # resolved
+    resolved_at = t2 + 110
+    # an immediate re-breach inside holddown_s (120) must NOT open a
+    # new alert -- flap frequency is bounded
+    eng.observe({"p99_latency_ms": 900.0}, now=resolved_at + 5)
+    assert eng.active() == []
+    # past the holddown it may alert again
+    t3 = resolved_at + rule.holddown_s + 10
+    eng.observe({"p99_latency_ms": 900.0}, now=t3)
+    assert [a.state for a in eng.active()] == ["pending"]
+
+
+def test_replica_down_fires_and_resolves_in_one_round():
+    eng = alerts.AlertEngine(rules=[r for r in alerts.default_rules()
+                                    if r.name == "replica_down"])
+    t = 1000.0
+    changed = eng.observe(
+        {"replica_down": 1.0},
+        detail={"replica_down": "rank 1 generation 0 (pid 7) died"},
+        now=t)
+    assert [a.state for a in changed] == ["pending", "firing"][1:] \
+        or [a.state for a in changed][-1] == "firing"
+    assert eng.firing()[0].reason.endswith(
+        "rank 1 generation 0 (pid 7) died")
+    # first healthy round resolves it (resolve_s=0)
+    changed = eng.observe({"replica_down": 0.0}, now=t + 1)
+    assert [a.state for a in changed] == ["resolved"]
+    assert eng.firing() == []
+
+
+def test_none_value_is_no_observation():
+    eng, _ = _engine()
+    assert eng.observe({"p99_latency_ms": None}, now=1.0) == []
+    assert eng.active() == []
+
+
+def test_history_ring_is_bounded():
+    rule = alerts.Rule("replica_down", threshold=0.0, fast_s=0.0,
+                       slow_s=0.0, resolve_s=0.0, holddown_s=0.0)
+    eng = alerts.AlertEngine(rules=[rule], history=4)
+    for i in range(20):
+        eng.observe({"replica_down": 1.0}, now=float(i))
+        eng.observe({"replica_down": 0.0}, now=float(i) + 0.5)
+    assert len(eng.history()) == 4
+
+
+def test_alertz_payload_shape():
+    eng, _ = _engine()
+    az = eng.alertz()
+    assert az["schema"] == "mxalertz.v1"
+    assert set(az) >= {"firing", "pending", "history", "rules"}
+    assert az["rules"][0]["name"] == "p99_latency_ms"
+
+
+def test_alert_transitions_publish_telemetry():
+    telemetry.enable()
+    eng = alerts.AlertEngine(rules=[r for r in alerts.default_rules()
+                                    if r.name == "replica_down"])
+    eng.observe({"replica_down": 1.0}, now=1.0)
+    eng.observe({"replica_down": 0.0}, now=2.0)
+    reg = telemetry.registry()
+    assert reg.get("fleet.alert").count >= 2
+    assert reg.get("fleet.alerts_firing").value == 0
+
+
+# ---------------------------------------------------------------------
+# FleetMonitor
+# ---------------------------------------------------------------------
+
+def _drain(rep, n_req=100, shed=0, errors=0, latency=()):
+    """Advance a fake replica's lifetime counters as one scrape-window
+    of traffic would."""
+    rep.requests += n_req
+    rep.responses += n_req - shed
+    rep.shed += shed
+    rep.errors += errors
+    for s in latency:
+        rep.add_latency(s)
+
+
+def test_monitor_aggregates_two_replicas():
+    r0 = _FakeReplica(rank=0, generation=0)
+    r1 = _FakeReplica(rank=1, generation=0)
+    mon = FleetMonitor([r0.url, r1.url], scrape_ms=50, retries=0)
+    try:
+        r0.served_step = 10
+        r1.served_step = 4
+        mon.poll_once()
+        # second round: deltas exist
+        _drain(r0, n_req=80, shed=20, latency=[0.001] * 50)
+        _drain(r1, n_req=100, errors=10, latency=[1.0] * 10)
+        time.sleep(0.02)
+        snap = mon.poll_once()
+        agg = snap["aggregate"]
+        assert agg["replicas"] == 2 and agg["up"] == 2
+        assert agg["qps"] is not None and agg["qps"] > 0
+        # shed_ratio = 20 / (180 + 20); error_ratio = 10 / (160 + 10)
+        assert agg["shed_ratio"] == pytest.approx(0.1)
+        assert agg["error_ratio"] == pytest.approx(10.0 / 170.0)
+        assert agg["served_step"]["skew"] == 6
+        # merged p99 lands in the slow replica's tail, not an average
+        assert agg["latency_ms"]["samples"] == 60
+        assert agg["latency_ms"]["p99"] >= 1000.0
+        assert agg["latency_ms"]["p50"] <= 2.1
+        states = {r["rank"]: r["state"] for r in snap["replicas"]}
+        assert states == {0: "ok", 1: "ok"}
+    finally:
+        mon.close()
+        r0.close()
+        r1.close()
+
+
+def test_monitor_ttl_flip_down_and_back():
+    rep = _FakeReplica(rank=0, generation=0)
+    mon = FleetMonitor([rep.url], scrape_ms=50, ttl_s=0.5, retries=0,
+                       timeout_s=0.5)
+    try:
+        t0 = time.time()
+        mon.poll_once(now=t0)
+        assert mon.last["replicas"][0]["state"] == "ok"
+        # replica goes bad but data is still fresh: sick, not down
+        rep.mode = "garbage"
+        mon.poll_once(now=time.time())
+        assert mon.last["replicas"][0]["state"] == "sick"
+        assert mon.engine.firing() == []
+        # stale past TTL => presumed down; replica_down fires naming
+        # rank + generation within the round
+        mon.poll_once(now=time.time() + 10.0)
+        assert mon.last["replicas"][0]["state"] == "down"
+        firing = mon.engine.firing()
+        assert [a.rule for a in firing] == ["replica_down"]
+        assert "rank 0" in firing[0].reason
+        assert "generation 0" in firing[0].reason
+        # recovery: next clean scrape flips it back and resolves
+        # (the engine's clock must keep moving forward)
+        rep.mode = "ok"
+        mon.poll_once(now=time.time() + 11.0)
+        assert mon.last["replicas"][0]["state"] == "ok"
+        assert mon.engine.firing() == []
+        assert mon.engine.history()[-1]["state"] == "resolved"
+    finally:
+        mon.close()
+        rep.close()
+
+
+def test_monitor_never_crashes_on_sick_replicas():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    refused = s.getsockname()[1]
+    s.close()
+    garbage = _FakeReplica(rank=1)
+    garbage.mode = "garbage"
+    wrong = _FakeReplica(rank=2)
+    wrong.mode = "wrong_schema"
+    mon = FleetMonitor(["http://127.0.0.1:%d" % refused, garbage.url,
+                        wrong.url],
+                       scrape_ms=50, retries=1, backoff_s=0.01,
+                       timeout_s=0.5)
+    try:
+        for _ in range(3):
+            snap = mon.poll_once()     # must not raise
+        assert {r["state"] for r in snap["replicas"]} <= {"sick",
+                                                          "down"}
+        assert all(r["failures"] >= 1 for r in snap["replicas"])
+    finally:
+        mon.close()
+        garbage.close()
+        wrong.close()
+
+
+def test_monitor_dead_pid_is_down_within_one_round(tmp_path):
+    d = str(tmp_path)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    (tmp_path / ("r0.%d.json" % p.pid)).write_text(json.dumps(
+        {"pid": p.pid, "rank": 0, "generation": 3, "port": port,
+         "started_at": 0.0}))
+    mon = FleetMonitor(d, scrape_ms=50, ttl_s=60.0, retries=0,
+                       timeout_s=0.3)
+    try:
+        mon.poll_once()      # one round: dead pid skips the TTL grace
+        assert mon.last["replicas"][0]["state"] == "down"
+        firing = mon.engine.firing()
+        assert [a.rule for a in firing] == ["replica_down"]
+        assert "generation 3" in firing[0].reason
+    finally:
+        mon.close()
+
+
+def test_monitor_generation_replacement_resolves(tmp_path):
+    """The supervisor-relaunch contract in miniature: rank 0's gen-0
+    registration goes stale (dead pid), the gen-1 replica re-registers
+    under the same rank, and the alert resolves."""
+    d = str(tmp_path)
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    (tmp_path / ("r0.%d.json" % p.pid)).write_text(json.dumps(
+        {"pid": p.pid, "rank": 0, "generation": 0, "port": 1,
+         "started_at": 0.0}))
+    mon = FleetMonitor(d, scrape_ms=50, retries=0, timeout_s=0.3)
+    rep = None
+    try:
+        mon.poll_once()
+        assert [a.rule for a in mon.engine.firing()] == ["replica_down"]
+        # generation 1 lands: same rank, live pid, real server
+        rep = _FakeReplica(rank=0, generation=1)
+        os.remove(str(tmp_path / ("r0.%d.json" % p.pid)))
+        (tmp_path / ("r0.%d.json" % os.getpid())).write_text(json.dumps(
+            {"pid": os.getpid(), "rank": 0, "generation": 1,
+             "port": rep.port, "started_at": 1.0}))
+        mon.poll_once()
+        assert mon.last["replicas"][0]["state"] == "ok"
+        assert mon.last["replicas"][0]["generation"] == 1
+        assert mon.engine.firing() == []
+    finally:
+        mon.close()
+        if rep is not None:
+            rep.close()
+
+
+def test_monitor_background_thread_starts_and_closes():
+    rep = _FakeReplica(rank=0)
+    mon = FleetMonitor([rep.url], scrape_ms=30, retries=0)
+    try:
+        mon.start()
+        deadline = time.monotonic() + 5.0
+        while mon.rounds < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mon.rounds >= 2
+    finally:
+        mon.close()
+        rep.close()
+    assert mon._thread is None
+    assert mon not in fleet._monitors
+
+
+def test_goodput_skew_generalized_across_replicas():
+    r0 = _FakeReplica(rank=0)
+    r1 = _FakeReplica(rank=1)
+    gp_fast = {"steps": 10, "wall_s": 1.0,
+               "categories": {"device_compute": {"per_step_s": 0.08},
+                              "input_wait": {"per_step_s": 0.01}}}
+    gp_slow = {"steps": 10, "wall_s": 3.0,
+               "categories": {"device_compute": {"per_step_s": 0.08},
+                              "input_wait": {"per_step_s": 0.21}}}
+    r0.statusz_goodput = gp_fast
+    r1.statusz_goodput = gp_slow
+    orig = _FakeReplica.statusz
+
+    def patched(rep):
+        sz = orig(rep)
+        sz["goodput"] = rep.statusz_goodput
+        return sz
+
+    _FakeReplica.statusz = patched
+    mon = FleetMonitor([r0.url, r1.url], scrape_ms=50, retries=0)
+    try:
+        snap = mon.poll_once()
+        skew = snap["aggregate"]["goodput_skew"]
+        assert skew["max_over_median"] == pytest.approx(3.0)
+        assert skew["straggler_ranks"] == [1]
+        attr = skew["attribution"][0]
+        assert attr["rank"] == 1 and attr["category"] == "input_wait"
+    finally:
+        _FakeReplica.statusz = orig
+        mon.close()
+        r0.close()
+        r1.close()
+
+
+def test_fleet_instruments_published():
+    telemetry.enable()
+    rep = _FakeReplica(rank=0)
+    mon = FleetMonitor([rep.url], scrape_ms=50, retries=0)
+    try:
+        mon.poll_once()
+        reg = telemetry.registry()
+        assert reg.get("fleet.scrapes").value >= 1
+        assert reg.get("fleet.replicas").value == 1
+        assert reg.get("fleet.alerts_firing").value == 0
+    finally:
+        mon.close()
+        rep.close()
+
+
+# ---------------------------------------------------------------------
+# wiring: /alertz, statusz fleet row, Features, env, supervisor
+# ---------------------------------------------------------------------
+
+def test_alertz_endpoint_and_statusz_fleet_row(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_TPU_OBS_ENDPOINTS_DIR", d)
+    port = obs.serve(0)
+    # no monitor yet: /alertz serves the empty shell
+    az = json.load(urllib.request.urlopen(
+        "http://127.0.0.1:%d/alertz" % port))
+    assert az["schema"] == "mxalertz.v1" and az["monitors"] == 0
+    mon = FleetMonitor(d, scrape_ms=50, retries=0)
+    try:
+        mon.poll_once()
+        az = json.load(urllib.request.urlopen(
+            "http://127.0.0.1:%d/alertz" % port))
+        assert az["monitors"] == 1
+        assert az["fleet"]["replicas"] == 1
+        assert az["fleet"]["alerts_firing"] == 0
+        assert [r["name"] for r in az["rules"]]
+        sz = json.load(urllib.request.urlopen(
+            "http://127.0.0.1:%d/statusz" % port))
+        assert sz["fleet"] == {"replicas": 1, "up": 1, "down": 0,
+                               "alerts_firing": 0}
+    finally:
+        mon.close()
+
+
+def test_features_fleet_row(tmp_path, monkeypatch):
+    from mxnet_tpu import runtime
+    monkeypatch.delenv("MXNET_TPU_OBS_ENDPOINTS_DIR", raising=False)
+    assert runtime.Features().is_enabled("FLEET") is False
+    monkeypatch.setenv("MXNET_TPU_OBS_ENDPOINTS_DIR", str(tmp_path))
+    assert runtime.Features().is_enabled("FLEET") is True
+
+
+def test_env_vars_registered():
+    from mxnet_tpu import env
+    assert env.get("MXNET_TPU_OBS_ENDPOINTS_DIR") == ""
+    assert env.get("MXNET_TPU_OBS_SCRAPE_MS") == 1000.0
+    assert env.get("MXNET_TPU_OBS_ALERT_RULES") == ""
+    doc = env.generate_doc()
+    for name in ("MXNET_TPU_OBS_ENDPOINTS_DIR",
+                 "MXNET_TPU_OBS_SCRAPE_MS",
+                 "MXNET_TPU_OBS_ALERT_RULES"):
+        assert name in doc
+
+
+def test_supervisor_threads_endpoints_dir(tmp_path):
+    from mxnet_tpu.supervisor import Supervisor
+    sup = Supervisor([sys.executable, "-c", "pass"], 2,
+                     max_restarts=0, grace_s=1.0,
+                     endpoints_dir=str(tmp_path))
+    env = sup._worker_env(3, 1, "127.0.0.1:1")
+    assert env["MXNET_TPU_OBS_ENDPOINTS_DIR"] == str(tmp_path)
+    assert env["MXNET_TPU_GENERATION"] == "3"
+    assert env["MXNET_TPU_PROC_ID"] == "1"
+    # and the base-env fallback path
+    sup2 = Supervisor([sys.executable, "-c", "pass"], 1,
+                      max_restarts=0, grace_s=1.0,
+                      env={"MXNET_TPU_OBS_ENDPOINTS_DIR": "/x"})
+    assert sup2._worker_env(0, 0, "c")["MXNET_TPU_OBS_ENDPOINTS_DIR"] \
+        == "/x"
+
+
+# ---------------------------------------------------------------------
+# the CLI exit-code contract
+# ---------------------------------------------------------------------
+
+def test_cli_fleet_usage_errors_exit_2(tmp_path, capsys):
+    rc = tcli.main(["fleet", str(tmp_path), "http://127.0.0.1:1"])
+    assert rc == 2
+    rc = tcli.main(["fleet", str(tmp_path / "missing")])
+    assert rc == 2
+
+
+def test_cli_fleet_healthy_exits_0(tmp_path, monkeypatch, capsys):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_TPU_OBS_ENDPOINTS_DIR", d)
+    obs.serve(0)
+    rc = tcli.main(["fleet", d, "--rounds", "2", "--interval-ms", "50"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet: 1 replica(s), 1 up / 0 down" in out
+    assert "alerts: 0 firing" in out
+
+
+def test_cli_fleet_firing_exits_1(capsys):
+    rep = _FakeReplica(rank=0)
+
+    def traffic(r):
+        r.requests += 80
+        r.responses += 80
+        r.shed += 20          # 20% shed >> the 5% SLO
+
+    rep.per_scrape = traffic
+    try:
+        rc = tcli.main(["fleet", rep.url, "--rounds", "3",
+                        "--interval-ms", "40"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "shed_ratio" in out
+    finally:
+        rep.close()
+
+
+def test_cli_fleet_nothing_scrapeable_exits_1(tmp_path, capsys):
+    rc = tcli.main(["fleet", str(tmp_path)])
+    assert rc == 1
+    assert "no scrapeable replica" in capsys.readouterr().err
+
+
+def test_cli_fleet_json_output(tmp_path, monkeypatch, capsys):
+    d = str(tmp_path)
+    monkeypatch.setenv("MXNET_TPU_OBS_ENDPOINTS_DIR", d)
+    obs.serve(0)
+    rc = tcli.main(["fleet", d, "--rounds", "1", "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["fleet"]["aggregate"]["replicas"] == 1
+    assert payload["alerts"]["schema"] == "mxalertz.v1"
