@@ -996,6 +996,105 @@ def bench_serving_hotswap(duration_s=2.0, clients=4, buckets=(1, 2, 4, 8),
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serving_decode(duration_s=2.0, clients=4, max_new=24,
+                         decode_buckets=(1, 2, 4, 8),
+                         prefill_buckets=(8, 16)):
+    """Generative serving throughput + token-latency tail (ISSUE 18
+    bench contract).
+
+    A tiny GPT behind the PRODUCT generative path
+    (``ModelRegistry.register_generative`` + ``generate()``: bucketed
+    prefill/decode AOT executables, paged KV cache, continuous
+    batching) takes closed-loop streaming traffic from ``clients``
+    threads for ``duration_s``.  Recorded: decoded tokens/s, TTFT
+    p50/p99 (submit -> first token, through the product stream), and
+    inter-token p50/p99 across all streams -- the two numbers a
+    generative SLO is written against -- plus mean step occupancy
+    (tokens/steps from the ``decode.*`` counters) and the shed count.
+    Runs on CPU.
+    """
+    import threading
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving.decode import tiny_gpt
+
+    model = tiny_gpt(vocab_size=64, units=32, num_layers=2,
+                     num_heads=2, max_seq=64)
+    params = model.init_params(0)
+    reg = mx.serving.ModelRegistry(compile_cache=False)
+    reg.register_generative("gpt", model, params=params,
+                            prefill_buckets=prefill_buckets,
+                            decode_buckets=decode_buckets,
+                            block_size=8, num_blocks=256,
+                            max_queue=64)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    telemetry.reset("decode.")
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 64, size=n)) for n in (3, 5, 8, 12)]
+    ttfts, gaps = [], []      # list.append is GIL-atomic
+    tokens = [0]
+    shed = [0]
+    try:
+        stop = time.perf_counter() + duration_s
+
+        def client(tid):
+            i = 0
+            while time.perf_counter() < stop:
+                t0 = time.perf_counter()
+                prev = None
+                try:
+                    stream = reg.generate(
+                        "gpt", prompts[(tid + i) % len(prompts)],
+                        max_new, timeout=30)
+                    for _tok in stream:
+                        now = time.perf_counter()
+                        if prev is None:
+                            ttfts.append(now - t0)
+                        else:
+                            gaps.append(now - prev)
+                        prev = now
+                        tokens[0] += 1
+                except Exception:
+                    shed[0] += 1
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(t,),
+                                    daemon=True)
+                   for t in range(clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+
+        def pct(lats, q):
+            lats = sorted(lats)
+            return round(1e3 * lats[min(len(lats) - 1,
+                                        int(q * len(lats)))], 3) \
+                if lats else None
+
+        steps = telemetry.counter("decode.steps").value
+        decoded = telemetry.counter("decode.tokens").value
+        return {
+            "tokens_per_s": round(tokens[0] / wall, 1)
+            if wall > 0 else None,
+            "streams": len(ttfts),
+            "ttft_p50_ms": pct(ttfts, 0.50),
+            "ttft_p99_ms": pct(ttfts, 0.99),
+            "inter_token_p50_ms": pct(gaps, 0.50),
+            "inter_token_p99_ms": pct(gaps, 0.99),
+            "mean_occupancy": round(decoded / steps, 3)
+            if steps else None,
+            "shed": shed[0],
+        }
+    finally:
+        reg.shutdown(drain=True)
+        if not was_enabled:
+            telemetry.disable()
+
+
 def bench_bert_base(batch_size=16, seq_len=128, vocab=30522,
                     dtype="float32", use_flash=None, iters=20,
                     windows=1):
@@ -1460,6 +1559,19 @@ def main():
                          "vs_baseline": None, **rec})
         except Exception as e:
             _print_line({"metric": "serving_hotswap",
+                         "error": str(e)[:200]})
+
+    # generative tier: tokens/s + TTFT + inter-token tail through the
+    # PRODUCT decode path (ISSUE 18 bench contract)
+    if _budget_ok("serving_decode", 90):
+        try:
+            rec = bench_serving_decode(
+                duration_s=3.0 if on_tpu else 2.0)
+            _print_line({"metric": "serving_decode",
+                         "unit": "tokens/s", "vs_baseline": None,
+                         **rec})
+        except Exception as e:
+            _print_line({"metric": "serving_decode",
                          "error": str(e)[:200]})
 
     if _budget_ok("lenet_mnist_train", 120):

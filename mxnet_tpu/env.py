@@ -222,6 +222,34 @@ _VARS = [
            "without bound -- the load-shedding/backpressure contract.  "
            "Per-servable override: ModelRegistry.register("
            "max_queue=...)."),
+    EnvVar("MXNET_TPU_SERVING_KV_BLOCK", int, 16,
+           "Tokens per KV-cache block in the generative decode tier "
+           "(mx.serving.decode).  Smaller blocks waste less memory on "
+           "partial tails (internal fragmentation is at worst one "
+           "block per sequence) but widen block tables; larger blocks "
+           "amortize table walks.  Per-model override: "
+           "register_generative(block_size=...)."),
+    EnvVar("MXNET_TPU_SERVING_KV_BLOCKS", int, 512,
+           "Total preallocated KV-cache blocks per generative "
+           "servable (block 0 is a reserved scratch block for padded "
+           "slots).  Together with MXNET_TPU_SERVING_KV_BLOCK this is "
+           "the serving memory budget: admission sheds "
+           "(ServingQueueFull, kvcache.alloc_failures) when a "
+           "request's whole prompt+max_new budget cannot be covered.  "
+           "Per-model override: register_generative(num_blocks=...)."),
+    EnvVar("MXNET_TPU_SERVING_DECODE_BUCKETS", str, "1,2,4,8",
+           "Slot-count buckets for the continuous-batching decode "
+           "step: each compiles one AOT executable at registration, "
+           "live sequences pad to the smallest bucket that fits, and "
+           "the largest bucket bounds concurrent sequences.  "
+           "Per-model override: register_generative("
+           "decode_buckets=...)."),
+    EnvVar("MXNET_TPU_SERVING_PREFILL_BUCKETS", str, "16,32,64,128",
+           "Prompt-length buckets for generative prefill: a prompt "
+           "pads to the smallest bucket >= its length (largest bucket "
+           "= longest admissible prompt), one warmed executable per "
+           "bucket.  Per-model override: register_generative("
+           "prefill_buckets=...)."),
     EnvVar("MXNET_TPU_SERVING_CACHE_DIR", str,
            "~/.cache/mxnet_tpu/serving",
            "Directory of the persistent serving compile cache: "

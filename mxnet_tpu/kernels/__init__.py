@@ -14,6 +14,10 @@ fallback (docs/kernels.md).  Three kernels ship through it:
   one concatenated per-dtype buffer (shared ``mxnet_tpu.bucketing``
   grouping), replacing the per-parameter elementwise-kernel swarm in
   the compiled train step.
+- ``paged_attention``: decode-step attention over the generative
+  serving tier's paged KV cache (``ops/pallas/paged_attention.py``):
+  one query token per slot walks its block table with online softmax;
+  XLA fallback gathers the table's blocks and masks.
 
 Selection policy (``registry.choose``): ``MXNET_TPU_KERNELS`` unset =
 auto (Pallas only where measured profitable, on TPU), ``1`` = forced

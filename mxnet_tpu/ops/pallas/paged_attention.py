@@ -1,0 +1,137 @@
+"""Decode-step paged attention (Pallas/TPU): one query token per
+sequence attends over block-gathered K/V from the serving tier's
+:class:`~mxnet_tpu.serving.decode.kvcache.PagedKVCache`.
+
+The prefill kernels (``flash_attention.py``) stream CONTIGUOUS K/V; at
+decode time a sequence's K/V is scattered over cache blocks named by
+its block table, so the kernel walks the table -- online softmax across
+blocks, exactly the flash discipline, but the block index is data (the
+table row), not the grid position.  The XLA reference gathers the
+table's blocks with one ``take`` and runs a masked softmax -- it is the
+CPU fallback and the numerics oracle the registry's interpret-mode
+contract is tested against.
+
+Layout: q ``(slots, heads, head_dim)``; per-layer cache slabs
+``(num_blocks, block_size, heads, head_dim)``; ``block_tables``
+``(slots, max_blocks)`` int32; ``context_lens`` ``(slots, 1)`` int32
+(tokens 0..ctx-1 are live).  fp32 accumulation regardless of cache
+dtype.  The whole slab pair is presented to each program (VMEM-bounded
+on real hardware -- sized for the serving tier's preallocated caches;
+interpret mode has no such bound).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+try:  # pallas import kept lazy-safe: CPU-only builds fall back to XLA
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+# ----------------------------------------------------------------------
+# XLA reference / fallback
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def paged_attention_reference(q, k_cache, v_cache, block_tables,
+                              context_lens, scale=1.0):
+    """Gather-then-softmax reference: ``take`` the table's blocks into
+    a contiguous ``(slots, max_blocks*block_size, heads, d)`` view and
+    mask positions past each slot's context length."""
+    s_, h, d = q.shape
+    nb, bs, _, _ = k_cache.shape
+    mb = block_tables.shape[1]
+    k = jnp.take(k_cache, block_tables, axis=0)        # (s, mb, bs, h, d)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    k = k.reshape(s_, mb * bs, h, d).astype(jnp.float32)
+    v = v.reshape(s_, mb * bs, h, d).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("shd,sthd->sht", qf, k) * scale
+    pos = jnp.arange(mb * bs, dtype=jnp.int32)
+    live = pos[None, None, :] < context_lens.reshape(s_, 1, 1)
+    scores = jnp.where(live, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("sht,sthd->shd", p / l, v)
+    return out.astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
+# Pallas kernel: grid over slots, online softmax across table blocks
+# ----------------------------------------------------------------------
+
+def _decode_kernel(q_ref, k_ref, v_ref, bt_ref, ctx_ref, o_ref, *,
+                   block_size, scale, max_blocks):
+    q = q_ref[0].astype(jnp.float32)              # (heads, d)
+    heads, d = q.shape
+    ctx = ctx_ref[0, 0]
+    num_blocks = jax.lax.div(ctx + block_size - 1, block_size)
+
+    def body(j, carry):
+        m, l, acc = carry
+        blk = bt_ref[0, j]
+        k = k_ref[blk].astype(jnp.float32)        # (bs, heads, d)
+        v = v_ref[blk].astype(jnp.float32)
+        # (heads, 1, d) x (heads, bs, d) -> (heads, 1, bs): one query
+        # row per head against the block's keys
+        s = jax.lax.dot_general(
+            q[:, None, :], k.transpose(1, 0, 2),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :] * scale
+        tpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (heads, block_size), 1)
+        s = jnp.where(tpos < ctx, s, NEG_INF)     # (heads, bs)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        # (heads, 1, bs) x (heads, bs, d) -> (heads, d)
+        pv = jax.lax.dot_general(
+            p[:, None, :], v.transpose(1, 0, 2),
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((heads, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((heads, 1), jnp.float32)
+    acc0 = jnp.zeros((heads, d), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_pallas(q, k_cache, v_cache, block_tables,
+                           context_lens, scale=1.0, interpret=False):
+    """q (slots, heads, d); caches (nb, bs, heads, d); block_tables
+    (slots, mb) int32; context_lens (slots, 1) int32 -> (slots, heads,
+    d)."""
+    slots, heads, d = q.shape
+    nb, bs, _, _ = k_cache.shape
+    mb = block_tables.shape[1]
+    kernel = functools.partial(_decode_kernel, block_size=bs,
+                               scale=scale, max_blocks=mb)
+    cache_spec = pl.BlockSpec((nb, bs, heads, d),
+                              lambda s: (0, 0, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid=(slots,),
+        in_specs=[
+            pl.BlockSpec((1, heads, d), lambda s: (s, 0, 0)),
+            cache_spec,
+            cache_spec,
+            pl.BlockSpec((1, mb), lambda s: (s, 0)),
+            pl.BlockSpec((1, 1), lambda s: (s, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, heads, d), lambda s: (s, 0, 0)),
+        interpret=interpret,
+    )(q, k_cache, v_cache, block_tables, context_lens)

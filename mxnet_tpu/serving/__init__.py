@@ -20,6 +20,13 @@ into an inference engine:
   p50/p95/p99, QPS, batch occupancy, queue depth, shed/timeout counts)
   through ``mx.telemetry``, summarized by the CLI's ``serving``
   section; ``bench.py::bench_serving`` emits the latency-vs-QPS curve;
+- **the generative tier** (``decode/``): autoregressive token
+  streaming -- prefill and decode as separately bucketed AOT
+  executables, a paged KV cache (fixed-size blocks + per-request block
+  tables), continuous batching (join at step boundaries, vacate on
+  finish, shed at admission when no blocks are free), mid-decode hot
+  swap with drain-to-completion, and the ``paged_attention`` kernel
+  walking the block table;
 - **the always-on loop** (``loop.py``): ``ContinuousTrainer`` publishes
   atomic checkpoints while ``RegistryWatcher`` discovers each new
   *verified* step and hot-swaps the servable with zero dropped
@@ -45,6 +52,9 @@ from __future__ import annotations
 from .batcher import (DynamicBatcher, RequestTimeout, ServableClosed,
                       ServingQueueFull)
 from .cache import CompileCache, stablehlo_fingerprint
+from .decode import (DecodeEngine, GenerationStream, GenerativeServable,
+                     GenerativeWatcher, KVCacheExhausted, PagedKVCache,
+                     TinyGPT, tiny_gpt)
 from .executor import BucketExecutorPool
 from .loop import ContinuousTrainer, RegistryWatcher
 from .registry import ModelRegistry, Servable
@@ -54,4 +64,7 @@ __all__ = [
     "CompileCache", "stablehlo_fingerprint",
     "ContinuousTrainer", "RegistryWatcher",
     "ServingQueueFull", "RequestTimeout", "ServableClosed",
+    "DecodeEngine", "GenerationStream", "GenerativeServable",
+    "GenerativeWatcher", "KVCacheExhausted", "PagedKVCache",
+    "TinyGPT", "tiny_gpt",
 ]

@@ -1,0 +1,43 @@
+"""``mxnet_tpu.serving.decode`` -- the generative serving tier.
+
+Autoregressive decoding is a different serving problem from the
+fixed-shape forwards the PR-8 tier batches: each request is a LOOP
+whose cost is unknown upfront (EOS-dependent), whose KV cache grows
+every step, and whose latency contract is per-token (TTFT + inter-token),
+not per-request.  This package is that tier:
+
+- :class:`~.kvcache.PagedKVCache` -- fixed-size blocks carved from
+  preallocated per-layer slabs; a per-request block table maps token
+  position -> (block, offset), so sequences grow without contiguous
+  reallocation and memory fragments at worst one partial block per
+  sequence (``kvcache.*`` telemetry).
+- :class:`~.engine.DecodeEngine` -- prefill and decode as SEPARATELY
+  bucketed AOT executables (prompt-length vs slot-count) with
+  continuous batching: requests join the running batch at step
+  boundaries, finished sequences vacate immediately, admission sheds
+  (``ServingQueueFull``) when the cache cannot cover a request's whole
+  ``prompt + max_new`` budget -- never mid-generation.
+- :class:`~.engine.GenerativeServable` /
+  :meth:`ModelRegistry.register_generative` /
+  :meth:`ModelRegistry.generate` -- the multi-tenant surface:
+  token-streaming iterators, mid-decode hot swap with drain-to-
+  completion on the old executables, ``/statusz`` + ``/healthz``
+  integration.
+- :class:`~.model.TinyGPT` -- a GPT-style decoder in pure-function
+  form (prefill + paged decode step + full-forward oracle), the
+  CI/bench workload.
+
+The decode-step attention itself is a kernel-registry citizen
+(``kernels.paged_attention``): a Pallas online-softmax walk over the
+slot's block table on TPU (interpret mode on CPU under
+``MXNET_TPU_KERNELS=1``), an XLA gather+masked-softmax fallback
+everywhere else.  docs/serving.md covers tuning.
+"""
+from .engine import (DecodeEngine, GenerationStream, GenerativeServable,
+                     GenerativeWatcher)
+from .kvcache import BlockTable, KVCacheExhausted, PagedKVCache
+from .model import TinyGPT, tiny_gpt
+
+__all__ = ["BlockTable", "DecodeEngine", "GenerationStream",
+           "GenerativeServable", "GenerativeWatcher",
+           "KVCacheExhausted", "PagedKVCache", "TinyGPT", "tiny_gpt"]

@@ -1,0 +1,705 @@
+"""The autoregressive decode engine: separately-bucketed prefill/decode
+AOT executables, continuous batching, token streaming.
+
+The PR-8 engine serves fixed-shape forwards; generation is a *loop*
+whose batch membership changes every step.  This module is the loop:
+
+- **executor split**: prefill (whole prompt -> cache blocks + first
+  token) compiles once per PROMPT-LENGTH bucket at batch 1; the decode
+  step (one token per slot over the paged cache) compiles once per
+  SLOT-COUNT bucket.  Both go through the same lower -> fingerprint ->
+  :class:`~mxnet_tpu.serving.cache.CompileCache` path as
+  ``BucketExecutorPool`` and warm at registration, so no request pays a
+  first-compile.
+- **continuous batching**: one worker thread runs an admit-then-step
+  loop.  Pending requests join the RUNNING batch at a step boundary
+  (one prefill call each), finished sequences vacate their slot the
+  step they finish, and the live slots pad up to the smallest decode
+  bucket -- no bucket flush, no drain-the-batch barrier (Orca's
+  iteration-level scheduling).
+- **admission backpressure**: the whole ``prompt + max_new`` KV budget
+  allocates at submit; an exhausted
+  :class:`~.kvcache.PagedKVCache` (or a full pending queue) sheds with
+  the standard :class:`~mxnet_tpu.serving.batcher.ServingQueueFull` --
+  a running sequence can never die for cache space.
+- **token streaming**: :meth:`DecodeEngine.submit` returns a
+  :class:`GenerationStream` iterator; every token lands there as it is
+  decoded, with ``serving.decode_step`` trace spans recorded as
+  children of the request's ``serving.request`` root, so TTFT and
+  inter-token latency are product-layer measurements
+  (``decode.ttft`` / ``decode.inter_token`` timers).
+
+Hot swap (the PR-12 contract extended mid-decode): re-registering a
+:class:`GenerativeServable` installs the replacement for NEW requests
+while the old engine's ``close(drain=True)`` keeps stepping its
+half-generated sequences to completion -- zero dropped sequences,
+counted under ``chaos.survived.serving.decode_swap``.
+"""
+from __future__ import annotations
+
+import collections
+import queue as _queue_mod
+import threading
+import time
+
+import numpy as np
+
+from ... import chaos as _chaos
+from ... import obs as _obs
+from ... import sync as _sync
+from ... import telemetry as _telemetry
+from ...base import MXNetError
+from ..batcher import RequestTimeout, ServableClosed, ServingQueueFull
+from ..cache import stablehlo_fingerprint
+from ..loop import RegistryWatcher as _RegistryWatcher
+from .kvcache import SCRATCH_BLOCK, KVCacheExhausted, PagedKVCache
+
+__all__ = ["DecodeEngine", "GenerationStream", "GenerativeServable",
+           "GenerativeWatcher"]
+
+_IDLE_WAIT_S = 0.05
+_DONE = object()
+
+
+def _env_buckets(var):
+    from ... import env as _env
+    spec = _env.get(var)
+    try:
+        return tuple(sorted({int(tok) for tok in str(spec).split(",")
+                             if tok}))
+    except ValueError as e:
+        raise MXNetError("%s=%r is not a comma-separated int list"
+                         % (var, spec)) from e
+
+
+class GenerationStream:
+    """Iterator over one request's generated token ids.
+
+    Tokens arrive as the engine decodes them; iteration blocks until
+    the next token, ``StopIteration`` lands after EOS / ``max_new`` /
+    cancel / drain, and an engine-side failure re-raises here.
+    ``cancel()`` asks the engine to drop the sequence at the next step
+    boundary (its cache blocks are freed there)."""
+
+    def __init__(self, model, prompt_len, max_new):
+        self.model = model
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self._q = _queue_mod.Queue()
+        self._error = None
+        self._finished = False
+        self.finish_reason = None       # eos | length | cancel | closed
+        self.cancelled = False
+        self.t_submit = time.perf_counter()
+        self.t_first_token = None
+
+    # -- engine side ----------------------------------------------------
+    def _push(self, token, now):
+        if self.t_first_token is None:
+            self.t_first_token = now
+        self._q.put(int(token))
+
+    def _finish(self, reason, error=None):
+        self.finish_reason = reason
+        self._error = error
+        self._q.put(_DONE)
+
+    # -- client side ----------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        item = self._q.get()
+        if item is _DONE:
+            self._finished = True
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    def cancel(self):
+        """Drop the sequence at the next step boundary (idempotent)."""
+        self.cancelled = True
+
+    def tokens(self):
+        """Drain the stream to completion and return every token."""
+        return list(self)
+
+    @property
+    def ttft_s(self):
+        """Submit -> first token, or None before the first token."""
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "table", "stream",
+                 "deadline", "tctx", "generated", "last_token",
+                 "t_last_emit", "t_submit")
+
+    def __init__(self, prompt, max_new, eos_id, table, stream, timeout):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.table = table
+        self.stream = stream
+        self.t_submit = stream.t_submit
+        self.deadline = (self.t_submit + timeout) if timeout else None
+        self.tctx = None
+        self.generated = 0
+        self.last_token = None
+        self.t_last_emit = None
+
+    @property
+    def position(self):
+        """Cache position the NEXT decode step writes (the last
+        generated token's index in the full sequence)."""
+        return len(self.prompt) + self.generated - 1
+
+
+class _AotPrograms:
+    """lower -> fingerprint -> CompileCache -> compile, per static
+    shape key (the BucketExecutorPool discipline generalized to
+    multi-argument decode/prefill signatures)."""
+
+    def __init__(self, cache=None, label="decode"):
+        self._cache = cache
+        self._label = label
+        self._programs = {}
+        self.fingerprints = {}
+
+    def build(self, key, fn, specs):
+        import jax
+        if key in self._programs:
+            return self._programs[key]
+        jfn = jax.jit(fn)
+        lowered = jfn.lower(*specs)
+        fp = stablehlo_fingerprint(lowered.as_text())
+        call = None
+        if self._cache is not None:
+            exported = self._cache.get(fp)
+            if exported is not None:
+                call = jax.jit(exported.call)
+        if call is None:
+            call = lowered.compile()
+            if self._cache is not None:
+                try:
+                    from jax import export as jexport
+                    self._cache.put(fp, jexport.export(jfn)(*specs))
+                except Exception:
+                    pass        # a cold next process, not an error now
+        self._programs[key] = call
+        self.fingerprints[key] = fp
+        return call
+
+    def get(self, key):
+        return self._programs[key]
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decode over a paged KV cache.
+
+    Parameters
+    ----------
+    model : :class:`~.model.TinyGPT`-shaped spec (``prefill_kv`` /
+        ``decode_logits`` / geometry attributes)
+    params : flat name -> device-array dict
+    prefill_buckets : prompt-length buckets (each compiles one prefill
+        executable at batch 1)
+    decode_buckets : slot-count buckets (each compiles one decode-step
+        executable); the largest is the concurrent-sequence bound
+    block_size / num_blocks : :class:`~.kvcache.PagedKVCache` geometry
+    max_queue : pending-request bound past which submits shed
+    cache : :class:`~mxnet_tpu.serving.cache.CompileCache` or None
+    """
+
+    def __init__(self, model, params, prefill_buckets=None,
+                 decode_buckets=None, block_size=None, num_blocks=None,
+                 max_queue=None, cache=None, label="generative",
+                 kv_dtype="float32"):
+        from ... import env as _env
+        self.model = model
+        self.params = params
+        self._label = label
+        if prefill_buckets is None:
+            prefill_buckets = _env_buckets(
+                "MXNET_TPU_SERVING_PREFILL_BUCKETS")
+        if decode_buckets is None:
+            decode_buckets = _env_buckets(
+                "MXNET_TPU_SERVING_DECODE_BUCKETS")
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in prefill_buckets)))
+        self.decode_buckets = tuple(sorted(set(
+            int(b) for b in decode_buckets)))
+        if not self.prefill_buckets or self.prefill_buckets[0] < 1 \
+                or not self.decode_buckets \
+                or self.decode_buckets[0] < 1:
+            raise MXNetError("decode engine: buckets must be positive "
+                             "ints, got prefill=%r decode=%r"
+                             % (prefill_buckets, decode_buckets))
+        # buckets past the model's context are uncompilable dead
+        # weight (the default env list serves models of any size):
+        # keep those that fit, plus one capped at max_seq so the
+        # longest admissible prompt stays servable
+        if self.prefill_buckets[-1] > model.max_seq:
+            kept = tuple(b for b in self.prefill_buckets
+                         if b < model.max_seq)
+            self.prefill_buckets = kept + (int(model.max_seq),)
+        block_size = int(block_size if block_size is not None
+                         else _env.get("MXNET_TPU_SERVING_KV_BLOCK"))
+        num_blocks = int(num_blocks if num_blocks is not None
+                         else _env.get("MXNET_TPU_SERVING_KV_BLOCKS"))
+        self.cache = PagedKVCache(model.num_layers, model.num_heads,
+                                  model.head_dim, block_size,
+                                  num_blocks, dtype=kv_dtype)
+        # fixed compiled block-table width: enough for the longest
+        # sequence the model can hold
+        self.max_blocks_per_seq = self.cache.blocks_for(model.max_seq)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _env.get("MXNET_TPU_SERVING_QUEUE"))
+        self.max_slots = self.decode_buckets[-1]
+        self._programs = _AotPrograms(cache=cache, label=label)
+        self._cond = _sync.Condition(name="serving.decode")
+        self._pending = collections.deque()
+        self._active = []
+        self._closed = False
+        self._drain = True
+        self._drained_live = 0      # sequences in flight at close()
+        self._thread = None
+
+    # -- AOT build ------------------------------------------------------
+    def _prefill_impl(self, params, kv_k, kv_v, tokens, table,
+                      true_len):
+        import jax.numpy as jnp
+        bs = self.cache.block_size
+        logits, ks, vs = self.model.prefill_kv(params, tokens)
+        lb = tokens.shape[1]
+        pos = jnp.arange(lb, dtype=jnp.int32)
+        blk = jnp.where(pos < true_len,
+                        jnp.take(table, pos // bs), SCRATCH_BLOCK)
+        off = pos % bs
+        kv_k = kv_k.at[:, blk, off].set(ks.astype(kv_k.dtype))
+        kv_v = kv_v.at[:, blk, off].set(vs.astype(kv_v.dtype))
+        last = jnp.take(logits[0], true_len - 1, axis=0)
+        first_token = jnp.argmax(last).astype(jnp.int32)
+        return first_token, kv_k, kv_v
+
+    def _decode_impl(self, params, kv_k, kv_v, tokens, positions,
+                     tables):
+        next_token, _logits, kv_k, kv_v = self.model.decode_logits(
+            params, kv_k, kv_v, tokens, positions, tables,
+            self.cache.block_size)
+        return next_token, kv_k, kv_v
+
+    def _specs(self):
+        import jax
+        i32 = np.int32
+        kv = jax.ShapeDtypeStruct(self.cache.keys.shape,
+                                  self.cache.keys.dtype)
+        pspec = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for n, v in self.params.items()}
+        mb = self.max_blocks_per_seq
+        prefill = {
+            b: (pspec, kv, kv,
+                jax.ShapeDtypeStruct((1, b), i32),
+                jax.ShapeDtypeStruct((mb,), i32),
+                jax.ShapeDtypeStruct((), i32))
+            for b in self.prefill_buckets}
+        decode = {
+            s: (pspec, kv, kv,
+                jax.ShapeDtypeStruct((s,), i32),
+                jax.ShapeDtypeStruct((s,), i32),
+                jax.ShapeDtypeStruct((s, mb), i32))
+            for s in self.decode_buckets}
+        return prefill, decode
+
+    def warmup(self):
+        """Compile every prefill and decode bucket (compile-cache
+        checked first); returns total warm-up seconds.  After this no
+        request can trigger a compile."""
+        t0 = time.perf_counter()
+        prefill, decode = self._specs()
+        for b, specs in prefill.items():
+            self._programs.build(("prefill", b), self._prefill_impl,
+                                 specs)
+        for s, specs in decode.items():
+            self._programs.build(("decode", s), self._decode_impl,
+                                 specs)
+        dt = time.perf_counter() - t0
+        if _telemetry._ENABLED:
+            _telemetry.hooks.serving_warmup(
+                self._label, dt,
+                len(self.prefill_buckets) + len(self.decode_buckets))
+        return dt
+
+    def _bucket(self, buckets, n, what):
+        for b in buckets:
+            if b >= n:
+                return b
+        raise MXNetError("decode engine: %s of %d exceeds the largest "
+                         "%s bucket %d" % (what, n, what, buckets[-1]))
+
+    # -- intake ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, eos_id=None, timeout=None):
+        """Admit one generation request; returns a
+        :class:`GenerationStream`.
+
+        The FULL ``prompt + max_new_tokens`` cache budget allocates
+        here -- :class:`ServingQueueFull` is raised when the pending
+        queue is at capacity or the KV cache cannot cover the budget
+        (``decode.shed`` + ``kvcache.alloc_failures``), so an accepted
+        request can never fail for cache space mid-generation."""
+        prompt = [int(t) for t in prompt]
+        max_new = int(max_new_tokens)
+        if not prompt or max_new < 1:
+            raise MXNetError("generate needs a non-empty prompt and "
+                             "max_new_tokens >= 1")
+        if len(prompt) > self.prefill_buckets[-1]:
+            raise MXNetError(
+                "prompt of %d tokens exceeds the largest prefill "
+                "bucket %d" % (len(prompt), self.prefill_buckets[-1]))
+        total = len(prompt) + max_new
+        if total > self.model.max_seq:
+            raise MXNetError(
+                "prompt + max_new_tokens = %d exceeds model max_seq %d"
+                % (total, self.model.max_seq))
+        with self._cond:
+            if self._closed:
+                raise ServableClosed("generative servable %r is closed"
+                                     % self._label)
+            if len(self._pending) >= self.max_queue:
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.decode_shed(self._label, "queue")
+                raise ServingQueueFull(
+                    "generative servable %r pending queue full (%d)"
+                    % (self._label, self.max_queue))
+            try:
+                table = self.cache.allocate(total)
+            except KVCacheExhausted as e:
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.decode_shed(self._label,
+                                                 "kvcache")
+                raise ServingQueueFull(
+                    "generative servable %r shed at admission: %s"
+                    % (self._label, e)) from e
+            stream = GenerationStream(self._label, len(prompt),
+                                      max_new)
+            req = _GenRequest(prompt, max_new, eos_id, table, stream,
+                              timeout)
+            if _obs._TRACE_ENABLED:
+                req.tctx = _obs.trace.fresh_context()
+            self._pending.append(req)
+            depth = len(self._pending)
+            self._cond.notify()
+        if _telemetry._ENABLED:
+            _telemetry.hooks.decode_request(self._label, depth)
+        return stream
+
+    # -- the loop -------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            raise MXNetError("DecodeEngine already started")
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True,
+            name="mxtpu-decode-%s" % self._label)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            with self._cond:
+                while not self._pending and not self._active \
+                        and not self._closed:
+                    self._cond.wait(_IDLE_WAIT_S)
+                if self._closed:
+                    if not self._drain:
+                        self._abort_locked()
+                        return
+                    if not self._pending and not self._active:
+                        return
+            self._admit()
+            if self._active:
+                self._step()
+
+    def _abort_locked(self):
+        """close(drain=False): resolve everything as closed, free every
+        table -- still zero *lost* streams, they all end explicitly."""
+        err = ServableClosed("generative servable %r closed without "
+                             "drain" % self._label)
+        for req in list(self._pending) + self._active:
+            self.cache.free(req.table)
+            req.stream._finish("closed", error=err)
+        self._pending.clear()
+        del self._active[:]
+
+    def _admit(self):
+        """Step-boundary admission: pending requests take free slots in
+        the RUNNING batch (one prefill each).  Expired/cancelled
+        requests resolve here and never occupy a slot."""
+        while True:
+            with self._cond:
+                if not self._pending \
+                        or len(self._active) >= self.max_slots:
+                    return
+                req = self._pending.popleft()
+            now = time.perf_counter()
+            if req.stream.cancelled:
+                self._finish(req, "cancel")
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self.cache.free(req.table)
+                req.stream._finish("timeout", error=RequestTimeout(
+                    "generation waited %.1fms > timeout while queued"
+                    % (1e3 * (now - req.t_submit))))
+                if _telemetry._ENABLED:
+                    _telemetry.hooks.serving_timeout(self._label)
+                continue
+            self._prefill(req)
+
+    def _prefill(self, req):
+        import jax
+        bucket = self._bucket(self.prefill_buckets, len(req.prompt),
+                              "prefill")
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(req.prompt)] = req.prompt
+        table = self.cache.padded_table(req.table,
+                                        self.max_blocks_per_seq)
+        t0 = time.perf_counter()
+        call = self._programs.get(("prefill", bucket))
+        try:
+            _chaos.fail_point("serving.decode.prefill",
+                              model=self._label, bucket=bucket)
+            first, kv_k, kv_v = call(
+                self.params, self.cache.keys, self.cache.values,
+                tokens, table, np.int32(len(req.prompt)))
+            first = int(jax.device_get(first))
+        except Exception as e:
+            if _telemetry._ENABLED:
+                _telemetry.hooks.serving_error(self._label)
+            self.cache.free(req.table)
+            req.stream._finish("error", error=e)
+            return
+        self.cache.keys, self.cache.values = kv_k, kv_v
+        self.cache.note_tokens(req.table, len(req.prompt) + 1)
+        now = time.perf_counter()
+        if _telemetry._ENABLED:
+            _telemetry.hooks.decode_prefill(self._label, bucket,
+                                            len(req.prompt), now - t0)
+            _telemetry.hooks.decode_ttft(now - req.t_submit)
+        self._emit(req, first, t0, now)
+        if not self._maybe_finish(req):
+            self._active.append(req)
+
+    def _step(self):
+        """ONE decode iteration for every live slot."""
+        import jax
+        n = len(self._active)
+        bucket = self._bucket(self.decode_buckets, n, "decode")
+        tokens = np.zeros((bucket,), np.int32)
+        positions = np.zeros((bucket,), np.int32)
+        tables = np.full((bucket, self.max_blocks_per_seq),
+                         SCRATCH_BLOCK, np.int32)
+        for i, req in enumerate(self._active):
+            tokens[i] = req.last_token
+            positions[i] = req.position
+            tables[i] = self.cache.padded_table(
+                req.table, self.max_blocks_per_seq)
+        t0 = time.perf_counter()
+        call = self._programs.get(("decode", bucket))
+        try:
+            _chaos.fail_point("serving.decode.step", model=self._label,
+                              occupancy=n, bucket=bucket)
+            out, kv_k, kv_v = call(self.params, self.cache.keys,
+                                   self.cache.values, tokens,
+                                   positions, tables)
+            out = jax.device_get(out)
+        except Exception as e:
+            if _telemetry._ENABLED:
+                _telemetry.hooks.serving_error(self._label)
+            for req in self._active:
+                self.cache.free(req.table)
+                req.stream._finish("error", error=e)
+            del self._active[:]
+            return
+        self.cache.keys, self.cache.values = kv_k, kv_v
+        now = time.perf_counter()
+        if _telemetry._ENABLED:
+            _telemetry.hooks.decode_step(self._label, n, bucket,
+                                         now - t0)
+        finished = []
+        for i, req in enumerate(self._active):
+            self._emit(req, int(out[i]), t0, now)
+            self.cache.note_tokens(req.table,
+                                   len(req.prompt) + req.generated)
+            if self._maybe_finish(req):
+                finished.append(req)
+        if finished:
+            # finished sequences vacate their slot IMMEDIATELY: the
+            # next iteration packs the survivors into a smaller bucket
+            self._active = [r for r in self._active
+                            if r not in finished]
+
+    def _emit(self, req, token, t_step0, now):
+        req.generated += 1
+        req.last_token = token
+        if _telemetry._ENABLED and req.t_last_emit is not None:
+            _telemetry.hooks.decode_inter_token(now - req.t_last_emit)
+        if _obs._TRACE_ENABLED and req.tctx is not None:
+            _obs.record_span(
+                "serving.decode_step", req.tctx.child(),
+                parent_id=req.tctx.span_id, t0=t_step0,
+                dur=now - t_step0,
+                attrs={"model": self._label,
+                       "token_index": req.generated - 1})
+        req.t_last_emit = now
+        req.stream._push(token, now)
+
+    def _maybe_finish(self, req):
+        if req.stream.cancelled:
+            self._finish(req, "cancel")
+            return True
+        if req.eos_id is not None and req.last_token == req.eos_id:
+            self._finish(req, "eos")
+            return True
+        if req.generated >= req.max_new:
+            self._finish(req, "length")
+            return True
+        return False
+
+    def _finish(self, req, reason):
+        self.cache.free(req.table)
+        now = time.perf_counter()
+        if _obs._TRACE_ENABLED and req.tctx is not None:
+            _obs.record_span(
+                "serving.request", req.tctx, t0=req.t_submit,
+                dur=now - req.t_submit,
+                attrs={"model": self._label, "generative": True,
+                       "tokens": req.generated, "reason": reason})
+        if _telemetry._ENABLED:
+            _telemetry.hooks.decode_finish(self._label, reason,
+                                           req.generated)
+            _telemetry.hooks.serving_latency(now - req.t_submit)
+        req.stream._finish(reason)
+
+    # -- introspection --------------------------------------------------
+    def queue_depth(self):
+        with self._cond:
+            return len(self._pending)
+
+    def active_sequences(self):
+        with self._cond:
+            return len(self._active)
+
+    def live_sequences(self):
+        with self._cond:
+            return len(self._pending) + len(self._active)
+
+    def fingerprint(self, kind, bucket):
+        return self._programs.fingerprints.get((kind, bucket))
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, drain=True):
+        """Stop intake and shut the loop down.  ``drain=True`` keeps
+        STEPPING until every admitted sequence runs to completion (the
+        mid-decode hot-swap path rides this); ``drain=False`` resolves
+        everything as closed.  Returns the number of sequences that
+        were in flight when close was called."""
+        with self._cond:
+            if self._closed:
+                return 0
+            self._closed = True
+            self._drain = drain
+            live = len(self._pending) + len(self._active)
+            self._drained_live = live
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        return live
+
+    @property
+    def closed(self):
+        return self._closed
+
+
+class GenerativeServable:
+    """One deployed generative model: a :class:`DecodeEngine` behind
+    the registry's servable surface (lookup / drain / introspection
+    compatible with :class:`~mxnet_tpu.serving.registry.Servable`)."""
+
+    source = "generative"
+
+    def __init__(self, name, engine):
+        self.name = name
+        self._engine = engine
+
+    # -- client surface -------------------------------------------------
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 timeout=None):
+        """Stream greedy-decoded tokens for ``prompt``; returns a
+        :class:`GenerationStream`."""
+        return self._engine.submit(prompt, max_new_tokens,
+                                   eos_id=eos_id, timeout=timeout)
+
+    # -- introspection --------------------------------------------------
+    @property
+    def engine(self):
+        return self._engine
+
+    @property
+    def buckets(self):
+        return self._engine.decode_buckets
+
+    @property
+    def prefill_buckets(self):
+        return self._engine.prefill_buckets
+
+    def queue_depth(self):
+        return self._engine.queue_depth()
+
+    @property
+    def queue_capacity(self):
+        return self._engine.max_queue
+
+    def kvcache_stats(self):
+        return self._engine.cache.stats()
+
+    @property
+    def closed(self):
+        return self._engine.closed
+
+    def close(self, drain=True):
+        return self._engine.close(drain=drain)
+
+    def __repr__(self):
+        return ("GenerativeServable(%r, prefill=%r, decode=%r, kv=%s)"
+                % (self.name, self._engine.prefill_buckets,
+                   self._engine.decode_buckets,
+                   self._engine.cache.stats()))
+
+
+class GenerativeWatcher(_RegistryWatcher):
+    """The :class:`~mxnet_tpu.serving.loop.RegistryWatcher` contract
+    for generative servables: same verified-step discovery, same
+    retry/backoff/failure-budget state machine (it IS a
+    RegistryWatcher), but a swap re-registers through
+    ``register_generative`` -- params restored from the checkpoint's
+    ``params`` item -- and the old engine drains its half-generated
+    sequences to completion (zero dropped, counted under
+    ``chaos.survived.serving.decode_swap``)."""
+
+    def __init__(self, registry, name, checkpoint, model, **kwargs):
+        # block/input_shape/dtype are fixed-shape-servable concepts;
+        # the base class only threads them into register(), which
+        # _register_step replaces wholesale
+        super().__init__(registry, name, checkpoint, block=None,
+                         input_shape=(), **kwargs)
+        self.model = model
+
+    def _register_step(self, step):
+        self.registry.register_generative(
+            self.name, model=self.model, checkpoint=self.manager,
+            step=step, **self._register_kwargs)

@@ -1,0 +1,221 @@
+"""Block/paged KV cache manager (the vLLM idea on bucketing.py's slab
+discipline).
+
+The generative engine never allocates per-request device memory: at
+construction it carves ONE preallocated per-layer slab pair -- keys and
+values, shape ``(layers, num_blocks, block_size, heads, head_dim)`` --
+into fixed-size blocks, and a request is admitted by handing it a
+**block table** (the ordered list of block ids its tokens map onto).
+Token position ``p`` of a request lives at
+``(table[p // block_size], p % block_size)``; the decode-step attention
+kernel gathers K/V through the table, so sequences share the slabs
+without ever being contiguous.
+
+Admission-time sizing is the backpressure contract: a request's whole
+budget -- ``prompt_len + max_new_tokens`` -- is allocated **at
+admission** and the allocator raises :class:`KVCacheExhausted` when the
+free list cannot cover it, so a running sequence can NEVER fail
+mid-generation for cache space (the engine maps the exhaustion to the
+standard :class:`~mxnet_tpu.serving.batcher.ServingQueueFull` shed).
+EOS, max-token completion, cancel and timeout all return blocks through
+:meth:`free` -- ``kvcache.blocks_in_use`` returning to zero after a
+drain is the leak-proof gate CI holds.
+
+Block 0 is reserved as the **scratch block**: padded decode slots and
+padded prefill positions route their writes there (a compiled program
+always writes *somewhere*), so it is never handed to a request and its
+contents are garbage by design.
+
+Telemetry: ``kvcache.blocks_in_use`` / ``kvcache.fragmentation``
+gauges, ``kvcache.allocs`` / ``kvcache.frees`` /
+``kvcache.alloc_failures`` counters.
+"""
+from __future__ import annotations
+
+from ... import sync as _sync
+from ... import telemetry as _telemetry
+from ...base import MXNetError
+
+__all__ = ["PagedKVCache", "BlockTable", "KVCacheExhausted",
+           "SCRATCH_BLOCK"]
+
+# block id 0 is the write sink for padded slots/positions; never
+# allocated to a request (see module doc)
+SCRATCH_BLOCK = 0
+
+
+class KVCacheExhausted(MXNetError):
+    """Admission-time allocation failed: the free list cannot cover the
+    request's ``prompt + max_new`` block budget.  The engine sheds the
+    request (ServingQueueFull) -- it is never raised mid-generation."""
+
+
+class BlockTable:
+    """One request's ordered block ids plus its token-capacity bound."""
+
+    __slots__ = ("blocks", "capacity", "freed")
+
+    def __init__(self, blocks, capacity):
+        self.blocks = list(blocks)
+        self.capacity = int(capacity)   # tokens the table can hold
+        self.freed = False
+
+    def __len__(self):
+        return len(self.blocks)
+
+    def __repr__(self):
+        return "BlockTable(blocks=%r, capacity=%d%s)" % (
+            self.blocks, self.capacity, ", freed" if self.freed else "")
+
+
+class PagedKVCache:
+    """Fixed-size block allocator over preallocated per-layer K/V slabs.
+
+    Parameters
+    ----------
+    layers, heads, head_dim : model geometry of the cached K/V
+    block_size : tokens per block
+    num_blocks : total blocks in the slab (block 0 is scratch, so the
+        allocatable pool is ``num_blocks - 1``)
+    dtype : cache dtype
+    """
+
+    def __init__(self, layers, heads, head_dim, block_size, num_blocks,
+                 dtype="float32"):
+        import jax.numpy as jnp
+        import numpy as np
+        if block_size < 1 or num_blocks < 2:
+            raise MXNetError(
+                "PagedKVCache needs block_size >= 1 and num_blocks >= 2 "
+                "(block 0 is the reserved scratch block), got "
+                "block_size=%r num_blocks=%r" % (block_size, num_blocks))
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.dtype = np.dtype(dtype)
+        shape = (self.layers, self.num_blocks, self.block_size,
+                 self.heads, self.head_dim)
+        # THE slabs: functional jax values the compiled prefill/decode
+        # programs consume and replace (the engine swaps the references
+        # after every step; on TPU donation makes that in-place)
+        self.keys = jnp.zeros(shape, self.dtype)
+        self.values = jnp.zeros(shape, self.dtype)
+        self._lock = _sync.Lock(name="serving.kvcache")
+        self._free = list(range(1, self.num_blocks))  # 0 = scratch
+        self._used_tokens = {}          # id(table) -> tokens written
+
+    # -- sizing ---------------------------------------------------------
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` (ceil)."""
+        return max(1, -(-int(n_tokens) // self.block_size))
+
+    @property
+    def total_blocks(self):
+        """Allocatable pool size (scratch excluded)."""
+        return self.num_blocks - 1
+
+    def free_blocks(self):
+        with self._lock:
+            return len(self._free)
+
+    def blocks_in_use(self):
+        with self._lock:
+            return self.total_blocks - len(self._free)
+
+    def can_admit(self, n_tokens):
+        """Whether :meth:`allocate` for ``n_tokens`` would succeed now
+        (admission pre-check; racing admitters still handle the
+        exception path)."""
+        with self._lock:
+            return self.blocks_for(n_tokens) <= len(self._free)
+
+    # -- allocate / free ------------------------------------------------
+    def allocate(self, n_tokens):
+        """Carve a :class:`BlockTable` holding ``n_tokens`` from the
+        free list, or raise :class:`KVCacheExhausted` (counted as
+        ``kvcache.alloc_failures``) without partial allocation."""
+        need = self.blocks_for(n_tokens)
+        with self._lock:
+            if need > len(self._free):
+                shortfall = (need, len(self._free))
+            else:
+                blocks = [self._free.pop() for _ in range(need)]
+                table = BlockTable(blocks,
+                                   capacity=need * self.block_size)
+                self._used_tokens[id(table)] = int(n_tokens)
+                in_use = self.total_blocks - len(self._free)
+                frag = self._fragmentation_locked()
+                shortfall = None
+        if shortfall is not None:
+            if _telemetry._ENABLED:
+                _telemetry.hooks.kvcache_alloc_failure()
+            raise KVCacheExhausted(
+                "kv cache exhausted: need %d blocks for %d tokens, "
+                "%d free (of %d)" % (shortfall[0], n_tokens,
+                                     shortfall[1], self.total_blocks))
+        if _telemetry._ENABLED:
+            _telemetry.hooks.kvcache_alloc(in_use, frag)
+        return table
+
+    def free(self, table):
+        """Return a table's blocks to the free list.  Idempotent -- the
+        EOS/timeout/cancel paths may race a drain, and double-freeing a
+        block would corrupt a live sequence."""
+        with self._lock:
+            if table.freed:
+                return
+            table.freed = True
+            self._free.extend(table.blocks)
+            self._used_tokens.pop(id(table), None)
+            in_use = self.total_blocks - len(self._free)
+            frag = self._fragmentation_locked()
+        if _telemetry._ENABLED:
+            _telemetry.hooks.kvcache_free(in_use, frag)
+
+    # -- introspection --------------------------------------------------
+    def _fragmentation_locked(self):
+        """Internal fragmentation: share of allocated token slots not
+        (yet) holding a token -- admission-time whole-budget allocation
+        makes this the honest cost of the shed-never-mid-generation
+        contract."""
+        in_use = self.total_blocks - len(self._free)
+        if in_use == 0:
+            return 0.0
+        used = sum(self._used_tokens.values())
+        return max(0.0, 1.0 - used / float(in_use * self.block_size))
+
+    def note_tokens(self, table, n_tokens):
+        """Update the written-token count for ``table`` (fragmentation
+        accounting only; capacity is fixed at admission)."""
+        with self._lock:
+            if not table.freed:
+                self._used_tokens[id(table)] = int(n_tokens)
+
+    def stats(self):
+        with self._lock:
+            in_use = self.total_blocks - len(self._free)
+            return {
+                "block_size": self.block_size,
+                "total_blocks": self.total_blocks,
+                "blocks_in_use": in_use,
+                "free_blocks": len(self._free),
+                "fragmentation": round(self._fragmentation_locked(), 4),
+            }
+
+    def padded_table(self, table, width):
+        """The table as a fixed-width int32 row for a compiled program:
+        real ids first, scratch-block padding after (padded positions
+        write into scratch, reads are masked by context length)."""
+        import numpy as np
+        if len(table.blocks) > width:
+            raise MXNetError(
+                "block table %d wider than compiled width %d"
+                % (len(table.blocks), width))
+        row = np.full((width,), SCRATCH_BLOCK, np.int32)
+        row[:len(table.blocks)] = table.blocks
+        return row
+
+    def __repr__(self):
+        return "PagedKVCache(%s)" % (self.stats(),)

@@ -388,6 +388,16 @@ class RegistryWatcher:
             if _sp is not None:
                 _obs.end_span(_sp)
 
+    def _register_step(self, step):
+        """ONE registration attempt for ``step`` -- the overridable
+        point subclasses (the generative watcher) replace to route a
+        swap through a different registry surface while inheriting the
+        whole retry/backoff/failure-budget state machine."""
+        self.registry.register(
+            self.name, block=self.block, checkpoint=self.manager,
+            step=step, input_shape=self.input_shape,
+            dtype=self.dtype, **self._register_kwargs)
+
     def _swap_attempts(self, step):
         from .. import chaos as _chaos
         t0 = time.perf_counter()
@@ -400,10 +410,7 @@ class RegistryWatcher:
                                    * (2 ** (attempt - 2))):
                     return None
             try:
-                self.registry.register(
-                    self.name, block=self.block, checkpoint=self.manager,
-                    step=step, input_shape=self.input_shape,
-                    dtype=self.dtype, **self._register_kwargs)
+                self._register_step(step)
             except Exception as e:
                 last_err = e
                 if _telemetry._ENABLED:

@@ -216,6 +216,92 @@ class ModelRegistry:
             _telemetry.hooks.serving_model(name, source, len(buckets))
         return servable
 
+    def register_generative(self, name, model, params=None,
+                            checkpoint=None, step=None,
+                            prefill_buckets=None, decode_buckets=None,
+                            block_size=None, num_blocks=None,
+                            max_queue=None, warmup=True,
+                            kv_dtype="float32"):
+        """Deploy an autoregressive decoder as a generative servable.
+
+        ``model`` is the pure-function spec
+        (:class:`~mxnet_tpu.serving.decode.TinyGPT`-shaped); weights
+        come from ``params=`` (a flat name->array dict) or
+        ``checkpoint=`` (a :class:`~mxnet_tpu.checkpoint.\
+CheckpointManager` root whose step carries a ``params`` item).
+        Registration warms every prefill and decode bucket, then
+        installs; re-registering a name swaps mid-decode safely -- the
+        old engine drains its half-generated sequences to completion on
+        its own executables while the replacement takes new requests
+        (zero dropped, ``chaos.survived.serving.decode_swap``).
+        """
+        from .decode.engine import DecodeEngine, GenerativeServable
+        if (params is None) == (checkpoint is None):
+            raise MXNetError("register_generative needs exactly one "
+                             "of params= / checkpoint=")
+        if checkpoint is not None:
+            params = self._restore_params(checkpoint, step)
+        pvals = {k: _device_value(v) for k, v in params.items()}
+        engine = DecodeEngine(model, pvals,
+                              prefill_buckets=prefill_buckets,
+                              decode_buckets=decode_buckets,
+                              block_size=block_size,
+                              num_blocks=num_blocks,
+                              max_queue=max_queue, cache=self._cache,
+                              label=name, kv_dtype=kv_dtype)
+        if warmup:
+            _w = _obs.begin_span("serving.register.warm", model=name) \
+                if _obs._TRACE_ENABLED else None
+            try:
+                engine.warmup()
+            finally:
+                if _w is not None:
+                    _obs.end_span(_w)
+        # same late-abort contract as register(): a chaos fault here
+        # (warmed, not yet installed) must leave the old servable --
+        # and every sequence it is mid-way through generating --
+        # untouched
+        _chaos.fail_point("serving.swap", model=name)
+        _i = _obs.begin_span("serving.register.install", model=name) \
+            if _obs._TRACE_ENABLED else None
+        try:
+            engine.start()
+            servable = GenerativeServable(name, engine)
+            with self._lock:
+                old = self._servables.get(name)
+                self._servables[name] = servable
+            if old is not None:
+                # drain=True keeps STEPPING the old engine until every
+                # half-generated sequence finishes on the old weights
+                live = old.close(drain=True)
+                if live:
+                    _chaos.survived("serving.decode_swap",
+                                    "drained %d live" % live)
+        finally:
+            if _i is not None:
+                _obs.end_span(_i)
+        if _telemetry._ENABLED:
+            _telemetry.hooks.serving_model(
+                name, "generative",
+                len(engine.prefill_buckets)
+                + len(engine.decode_buckets))
+        return servable
+
+    @staticmethod
+    def _restore_params(checkpoint, step):
+        from ..checkpoint import CheckpointManager
+        mgr = checkpoint if isinstance(checkpoint, CheckpointManager) \
+            else CheckpointManager(checkpoint)
+        ckpt = mgr.restore(step=step)
+        if ckpt is None:
+            raise MXNetError("serving: no intact checkpoint under %r"
+                             % mgr.root)
+        if "params" not in ckpt.items:
+            raise MXNetError(
+                "serving: checkpoint step %d has no 'params' item "
+                "(items: %s)" % (ckpt.step, sorted(ckpt.items)))
+        return ckpt.items["params"]
+
     @staticmethod
     def _restore_checkpoint(block, checkpoint, step):
         from ..checkpoint import CheckpointManager
@@ -324,6 +410,30 @@ class ModelRegistry:
     def infer(self, name, x, timeout=None):
         fut = self.submit(name, x, timeout=timeout)
         return fut.result(timeout=timeout)
+
+    def generate(self, name, prompt, max_new_tokens, eos_id=None,
+                 timeout=None):
+        """Stream generated tokens from the named generative servable
+        (an iterator of ints -- the
+        :class:`~mxnet_tpu.serving.decode.GenerationStream`).  Same
+        swap-race retry as :meth:`submit`: a hot swap between lookup
+        and admit lands the request on the replacement."""
+        for _ in range(8):
+            s = self.servable(name)
+            if not hasattr(s, "generate"):
+                raise MXNetError("serving: servable %r (source=%r) is "
+                                 "not generative" % (name, s.source))
+            try:
+                return s.generate(prompt, max_new_tokens,
+                                  eos_id=eos_id, timeout=timeout)
+            except ServableClosed:
+                with self._lock:
+                    cur = self._servables.get(name)
+                if cur is None or cur is s:
+                    raise               # really closed, not swapped
+        raise ServableClosed(
+            "serving: servable %r kept closing mid-generate (flapping "
+            "re-registration?)" % name)
 
     # -- lifecycle ------------------------------------------------------
     def unregister(self, name, drain=True):

@@ -42,6 +42,9 @@ __all__ = [
     "supervisor_restart", "supervisor_exhausted",
     "serving_error", "fleet_scrape", "fleet_replica_down",
     "fleet_round", "fleet_alert", "fleet_alerts_firing",
+    "decode_request", "decode_shed", "decode_prefill", "decode_step",
+    "decode_ttft", "decode_inter_token", "decode_finish",
+    "kvcache_alloc", "kvcache_free", "kvcache_alloc_failure",
 ]
 
 
@@ -308,6 +311,87 @@ def serving_swap(model, step, seconds, ok, from_step=None, attempt=1,
     reg.event("serving.swap").emit(model=model, step=step, ok=bool(ok),
                                    from_step=from_step, attempt=attempt,
                                    seconds=seconds, error=error)
+
+
+def decode_request(model, queue_depth):
+    """One generation request admitted to a decode engine."""
+    reg = _registry()
+    reg.counter("decode.requests").inc()
+    reg.gauge("decode.queue_depth").set(queue_depth)
+
+
+def decode_shed(model, reason):
+    """Admission backpressure: a generation request was shed at submit
+    (``reason``: ``queue`` = pending queue full, ``kvcache`` = the KV
+    cache cannot cover the request's whole token budget)."""
+    reg = _registry()
+    reg.counter("decode.shed").inc()
+    reg.counter("decode.shed." + reason).inc()
+
+
+def decode_prefill(model, bucket, prompt_len, seconds):
+    """One prompt prefilled into cache blocks (the first token's
+    compiled call, bucketed by padded prompt length)."""
+    reg = _registry()
+    reg.counter("decode.prefills").inc()
+    reg.timer("decode.prefill_time").observe(seconds, model=model,
+                                             bucket=bucket,
+                                             prompt_len=prompt_len)
+
+
+def decode_step(model, occupancy, bucket, seconds):
+    """One continuous-batching decode iteration: ``occupancy`` live
+    sequences padded to the ``bucket`` slot count."""
+    reg = _registry()
+    reg.counter("decode.steps").inc()
+    reg.counter("decode.tokens").inc(int(occupancy))
+    reg.gauge("decode.occupancy").set(occupancy)
+    reg.timer("decode.step_time").observe(seconds, model=model,
+                                          bucket=bucket,
+                                          occupancy=occupancy)
+
+
+def decode_ttft(seconds):
+    """Submit -> first streamed token (the product-layer TTFT)."""
+    _registry().timer("decode.ttft").observe(seconds)
+
+
+def decode_inter_token(seconds):
+    """Gap between consecutive streamed tokens of one request."""
+    _registry().timer("decode.inter_token").observe(seconds)
+
+
+def decode_finish(model, reason, tokens):
+    """One generation finished (``reason``: eos / length / cancel /
+    timeout / error / closed)."""
+    reg = _registry()
+    reg.counter("decode.finished").inc()
+    reg.event("decode.finish").emit(model=model, reason=reason,
+                                    tokens=int(tokens))
+
+
+def kvcache_alloc(in_use, fragmentation):
+    """A block-table allocation succeeded; gauges carry the cache's
+    post-alloc occupancy and internal fragmentation (unused fraction
+    of allocated blocks)."""
+    reg = _registry()
+    reg.counter("kvcache.allocs").inc()
+    reg.gauge("kvcache.blocks_in_use").set(in_use)
+    reg.gauge("kvcache.fragmentation").set(fragmentation)
+
+
+def kvcache_free(in_use, fragmentation):
+    """A finished/cancelled sequence returned its blocks."""
+    reg = _registry()
+    reg.counter("kvcache.frees").inc()
+    reg.gauge("kvcache.blocks_in_use").set(in_use)
+    reg.gauge("kvcache.fragmentation").set(fragmentation)
+
+
+def kvcache_alloc_failure():
+    """An allocation found too few free blocks (the admission-shed
+    trigger; never fires mid-generation by construction)."""
+    _registry().counter("kvcache.alloc_failures").inc()
 
 
 def train_publish(step, seconds):
@@ -855,6 +939,48 @@ INSTRUMENTS = [
         "one alert state transition (pending/firing/resolved/"
         "cancelled); payload carries rule + reason naming the "
         "replica"),
+    _ii("decode.requests", "counter", "serving", 18,
+        "generation requests admitted to a decode engine"),
+    _ii("decode.queue_depth", "gauge", "serving", 18,
+        "generation requests waiting for a decode slot"),
+    _ii("decode.shed", "counter", "serving", 18,
+        "generation requests shed at admission (queue full or KV "
+        "budget unavailable; never mid-generation)"),
+    _ii("decode.shed.<reason>", "counter", "serving", 18,
+        "per-reason shed count (queue / kvcache)"),
+    _ii("decode.prefills", "counter", "serving", 18,
+        "prompt prefill calls (one per admitted request)"),
+    _ii("decode.prefill_time", "timer", "serving", 18,
+        "prefill call wall time, tagged bucket + prompt_len"),
+    _ii("decode.steps", "counter", "serving", 18,
+        "continuous-batching decode iterations"),
+    _ii("decode.tokens", "counter", "serving", 18,
+        "tokens decoded (occupancy summed over steps)"),
+    _ii("decode.occupancy", "gauge", "serving", 18,
+        "live sequences in the running decode batch"),
+    _ii("decode.step_time", "timer", "serving", 18,
+        "decode iteration wall time, tagged bucket + occupancy"),
+    _ii("decode.ttft", "timer", "serving", 18,
+        "submit -> first streamed token (product-layer TTFT)"),
+    _ii("decode.inter_token", "timer", "serving", 18,
+        "gap between consecutive streamed tokens of one request"),
+    _ii("decode.finished", "counter", "serving", 18,
+        "generations finished (any reason)"),
+    _ii("decode.finish", "event", "serving", 18,
+        "one finished generation; payload carries reason (eos/length/"
+        "cancel/timeout/error/closed) + token count"),
+    _ii("kvcache.allocs", "counter", "serving", 18,
+        "block-table allocations (one per admitted request)"),
+    _ii("kvcache.frees", "counter", "serving", 18,
+        "block tables returned (EOS/length/cancel/timeout/error)"),
+    _ii("kvcache.alloc_failures", "counter", "serving", 18,
+        "allocations refused for too few free blocks (admission-shed "
+        "trigger)"),
+    _ii("kvcache.blocks_in_use", "gauge", "serving", 18,
+        "KV cache blocks currently allocated across live sequences"),
+    _ii("kvcache.fragmentation", "gauge", "serving", 18,
+        "unused fraction of allocated KV blocks (internal "
+        "fragmentation; at worst one partial block per sequence)"),
     _ii("env.dispatch_roundtrip_us", "gauge", "bench", 13,
         "bench env-health dispatch round trip (the degraded_env "
         "basis)"),

@@ -39,6 +39,13 @@ def bench_mod(monkeypatch):
                             "p99_during_swap_ms": 9.0,
                             "requests": 1000,
                             "requests_during_swap": 80, "dropped": 0})
+    monkeypatch.setattr(bench, "bench_serving_decode",
+                        lambda *a, **k: {
+                            "tokens_per_s": 4200.0, "streams": 60,
+                            "ttft_p50_ms": 8.0, "ttft_p99_ms": 20.0,
+                            "inter_token_p50_ms": 2.0,
+                            "inter_token_p99_ms": 6.0,
+                            "mean_occupancy": 3.1, "shed": 0})
     monkeypatch.setattr(bench, "bench_lenet_imperative",
                         lambda *a, **k: 25000.0)
     monkeypatch.setattr(bench, "bench_resnet50", lambda *a, **k: 1500.0)
@@ -326,6 +333,34 @@ def test_serving_hotswap_line_emits(bench_mod, capsys):
                 "requests_during_swap", "dropped"):
         assert key in rec, key
     assert rec["dropped"] == 0
+
+
+def test_serving_decode_line_emits(bench_mod, capsys):
+    """ISSUE 18 bench contract: the generative-tier line carries
+    tokens/s, TTFT and inter-token percentiles, occupancy, and shed."""
+    bench_mod.main()
+    _metrics_list, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    rec = by["serving_decode"]
+    assert rec["unit"] == "tokens/s"
+    for key in ("tokens_per_s", "streams", "ttft_p50_ms",
+                "ttft_p99_ms", "inter_token_p50_ms",
+                "inter_token_p99_ms", "mean_occupancy", "shed"):
+        assert key in rec, key
+    assert "degraded_env" in rec
+
+
+def test_serving_decode_bench_uses_product_path(monkeypatch):
+    """Source contract on the UNPATCHED module: the generative bench
+    streams through ModelRegistry.register_generative/generate and
+    reads the decode.* telemetry counters, not bench-local
+    scaffolding."""
+    import inspect
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    src = inspect.getsource(bench.bench_serving_decode)
+    assert "register_generative" in src and "reg.generate" in src
+    assert "decode.steps" in src and "decode.tokens" in src
 
 
 def test_hotswap_bench_uses_product_loop(monkeypatch):

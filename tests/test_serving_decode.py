@@ -1,0 +1,454 @@
+"""Generative serving tier tests (ISSUE 18): paged KV cache block
+lifecycle, decode-step paged attention numerics (Pallas interpret vs
+XLA reference), prefill+decode vs the full-forward oracle, continuous
+batching (join mid-batch bit-identical, occupancy > 1), admission
+backpressure, token streaming with per-token trace spans, mid-decode
+hot swap under chaos, and the GenerativeWatcher."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, obs, serving, telemetry
+from mxnet_tpu.serving import (RequestTimeout, ServableClosed,
+                               ServingQueueFull)
+from mxnet_tpu.serving.decode import (DecodeEngine, GenerativeWatcher,
+                                      KVCacheExhausted, PagedKVCache,
+                                      tiny_gpt)
+from mxnet_tpu.serving.decode.kvcache import SCRATCH_BLOCK
+
+MODEL = tiny_gpt(vocab_size=32, units=16, num_layers=2, num_heads=2,
+                 max_seq=32)
+ENGINE_KW = dict(prefill_buckets=(8, 16), decode_buckets=(1, 2, 4),
+                 block_size=4, num_blocks=64, max_queue=16)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return MODEL.init_params(0)
+
+
+@pytest.fixture(scope="module")
+def ccache(tmp_path_factory):
+    # shared on-disk compile cache: the first engine pays the AOT
+    # compiles, every later engine warms from disk
+    return serving.CompileCache(str(tmp_path_factory.mktemp("cc")))
+
+
+@pytest.fixture()
+def make_engine(params, ccache):
+    engines = []
+
+    def _make(**overrides):
+        kw = dict(ENGINE_KW, cache=ccache, **overrides)
+        eng = DecodeEngine(MODEL, params, **kw)
+        eng.warmup()
+        eng.start()
+        engines.append(eng)
+        return eng
+
+    yield _make
+    for eng in engines:
+        eng.close(drain=False)
+
+
+@pytest.fixture()
+def registry(ccache, tmp_path):
+    reg = serving.ModelRegistry(cache_dir=str(tmp_path / "reg_cc"))
+    reg._cache = ccache
+    yield reg
+    reg.shutdown(drain=True)
+
+
+@pytest.fixture()
+def counters():
+    telemetry.enable()
+    for prefix in ("decode.", "kvcache.", "serving.", "chaos."):
+        telemetry.reset(prefix)
+    yield telemetry
+    for prefix in ("decode.", "kvcache.", "serving.", "chaos."):
+        telemetry.reset(prefix)
+    telemetry.disable()
+
+
+def _reference(params, prompt, max_new, eos_id=None):
+    return MODEL.reference_decode(params, prompt, max_new, eos_id=eos_id)
+
+
+# ---------------------------------------------------------------------
+# paged KV cache
+# ---------------------------------------------------------------------
+
+def test_kvcache_alloc_free_cycle():
+    c = PagedKVCache(2, 2, 8, block_size=4, num_blocks=16)
+    assert c.total_blocks == 15          # block 0 reserved as scratch
+    t = c.allocate(10)                   # ceil(10/4) = 3 blocks
+    assert len(t.blocks) == 3
+    assert SCRATCH_BLOCK not in t.blocks
+    assert c.blocks_in_use() == 3
+    assert c.free_blocks() == 12
+    c.free(t)
+    assert c.blocks_in_use() == 0
+    c.free(t)                            # idempotent
+    assert c.blocks_in_use() == 0
+
+
+def test_kvcache_exhaustion_and_can_admit():
+    c = PagedKVCache(1, 1, 4, block_size=4, num_blocks=5)  # 4 usable
+    t = c.allocate(12)                   # 3 of 4
+    assert c.can_admit(4) and not c.can_admit(5)
+    with pytest.raises(KVCacheExhausted):
+        c.allocate(8)
+    assert c.blocks_in_use() == 3        # failed alloc left no debris
+    c.free(t)
+    c.allocate(16)                       # the whole cache fits again
+
+
+def test_kvcache_fragmentation_and_padded_table():
+    c = PagedKVCache(1, 1, 4, block_size=4, num_blocks=16)
+    t = c.allocate(6)                    # 2 blocks for 6 tokens
+    c.note_tokens(t, 5)                  # 5 live of 8 allocated slots
+    assert c.stats()["fragmentation"] == pytest.approx(3 / 8)
+    padded = c.padded_table(t, 6)
+    assert padded.shape == (6,) and padded.dtype == np.int32
+    assert list(padded[:2]) == list(t.blocks)
+    assert all(b == SCRATCH_BLOCK for b in padded[2:])
+    c.free(t)
+
+
+# ---------------------------------------------------------------------
+# paged attention kernel
+# ---------------------------------------------------------------------
+
+def test_paged_attention_pallas_matches_reference():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.paged_attention import (
+        paged_attention_pallas, paged_attention_reference)
+    rng = np.random.default_rng(0)
+    nb, bs, h, d = 8, 4, 2, 8
+    k = jnp.asarray(rng.normal(size=(nb, bs, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(nb, bs, h, d)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(3, h, d)).astype(np.float32))
+    bt = jnp.asarray(np.array([[1, 2, 3, 0], [4, 5, 0, 0],
+                               [6, 7, 1, 2]], np.int32))
+    ctx = jnp.asarray(np.array([[10], [5], [16]], np.int32))
+    ref = paged_attention_reference(q, k, v, bt, ctx, scale=0.35)
+    pal = paged_attention_pallas(q, k, v, bt, ctx, scale=0.35,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_paged_attention_reference_masks_dead_context():
+    # tokens past context_lens must not contribute: poison them
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.paged_attention import (
+        paged_attention_reference)
+    rng = np.random.default_rng(1)
+    k = rng.normal(size=(4, 4, 1, 4)).astype(np.float32)
+    v = rng.normal(size=(4, 4, 1, 4)).astype(np.float32)
+    q = jnp.asarray(rng.normal(size=(1, 1, 4)).astype(np.float32))
+    bt = jnp.asarray(np.array([[1, 2]], np.int32))
+    ctx = jnp.asarray(np.array([[5]], np.int32))
+    base = paged_attention_reference(q, jnp.asarray(k), jnp.asarray(v),
+                                     bt, ctx)
+    k[2, 1:], v[2, 1:] = 1e6, 1e6        # positions 5..7: dead
+    poisoned = paged_attention_reference(q, jnp.asarray(k),
+                                         jnp.asarray(v), bt, ctx)
+    np.testing.assert_allclose(np.asarray(poisoned), np.asarray(base),
+                               atol=1e-6)
+
+
+def test_paged_attention_is_registered():
+    from mxnet_tpu import kernels
+    assert "paged_attention" in kernels.list_kernels()
+    ch = kernels.choose("paged_attention", heads=2, head_dim=8,
+                        block_size=4)
+    assert isinstance(ch.use_pallas, bool)
+
+
+# ---------------------------------------------------------------------
+# engine: numerics + streaming
+# ---------------------------------------------------------------------
+
+def test_engine_matches_full_forward_oracle(make_engine, params):
+    eng = make_engine()
+    for prompt in ([3, 7, 1, 9, 2], [5, 5, 6], [1]):
+        stream = eng.submit(prompt, 8)
+        assert stream.tokens() == _reference(params, prompt, 8)
+    assert eng.cache.blocks_in_use() == 0
+
+
+def test_engine_streams_incrementally(make_engine):
+    eng = make_engine()
+    stream = eng.submit([3, 7, 1], 6)
+    seen = []
+    for tok in stream:
+        seen.append(tok)
+        assert stream.ttft_s is not None and stream.ttft_s >= 0
+    assert len(seen) == 6
+    assert stream.finish_reason == "length"
+
+
+def test_engine_eos_stops_and_frees(make_engine, params):
+    eng = make_engine()
+    ref = _reference(params, [5, 5, 6], 10)
+    eos = ref[2]                         # an id the model will emit
+    stream = eng.submit([5, 5, 6], 10, eos_id=eos)
+    toks = stream.tokens()
+    assert toks == _reference(params, [5, 5, 6], 10, eos_id=eos)
+    assert toks[-1] == eos and len(toks) <= 10
+    assert stream.finish_reason == "eos"
+    assert eng.cache.blocks_in_use() == 0
+
+
+def test_engine_rejects_over_budget_prompts(make_engine):
+    eng = make_engine()
+    with pytest.raises(mx.MXNetError):
+        eng.submit(list(range(17)), 4)   # > largest prefill bucket
+    with pytest.raises(mx.MXNetError):
+        eng.submit([1, 2, 3], 30)        # 33 > max_seq 32
+    with pytest.raises(mx.MXNetError):
+        eng.submit([], 4)
+
+
+# ---------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------
+
+def test_join_mid_batch_is_bit_identical(make_engine, params, counters):
+    eng = make_engine()
+    prompts = [[3, 7, 1, 9, 2], [5, 5, 6], [1, 2, 3, 4], [9, 8, 7]]
+    solo = [_reference(params, p, 10) for p in prompts]
+    results = {}
+
+    def run(i, delay):
+        time.sleep(delay)
+        results[i] = eng.submit(prompts[i], 10).tokens()
+
+    # throttled steps pin the stagger inside the running batch (a fast
+    # machine must not finish stream 0 before stream 1 arrives)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.02))
+        threads = [threading.Thread(target=run, args=(i, 0.01 * i))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i in range(len(prompts)):
+        assert results[i] == solo[i], "slot %d diverged" % i
+    # occupancy > 1 at some step <=> more tokens than iterations
+    assert counters.counter("decode.tokens").value \
+        > counters.counter("decode.steps").value
+    assert eng.cache.blocks_in_use() == 0
+
+
+def test_finished_sequences_vacate_immediately(make_engine, params):
+    eng = make_engine()
+    short = eng.submit([5, 5, 6], 2)
+    long = eng.submit([3, 7, 1, 9, 2], 12)
+    assert short.tokens() == _reference(params, [5, 5, 6], 2)
+    # the long request keeps generating after the short one vacated
+    assert long.tokens() == _reference(params, [3, 7, 1, 9, 2], 12)
+    assert eng.cache.blocks_in_use() == 0
+
+
+# ---------------------------------------------------------------------
+# admission backpressure + lifecycle
+# ---------------------------------------------------------------------
+
+def test_admission_sheds_on_kv_exhaustion_never_midflight(
+        make_engine, params, counters):
+    # 9 usable blocks of 4 = 36 token-slots; one request budgets
+    # 5 + 12 = 17 -> 5 blocks, so a second identical one must shed
+    eng = make_engine(num_blocks=10)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.02))
+        first = eng.submit([3, 7, 1, 9, 2], 12)
+        time.sleep(0.05)                 # first is mid-generation now
+        with pytest.raises(ServingQueueFull):
+            eng.submit([3, 7, 1, 9, 2], 12)
+        # the in-flight sequence is untouched by the shed
+        assert first.tokens() == _reference(params, [3, 7, 1, 9, 2], 12)
+    assert counters.counter("decode.shed").value == 1
+    assert counters.counter("decode.shed.kvcache").value == 1
+    assert counters.counter("kvcache.alloc_failures").value == 1
+    assert eng.cache.blocks_in_use() == 0
+    eng.submit([1], 2).tokens()          # sheds recover
+
+
+def test_admission_sheds_on_queue_full(make_engine, counters):
+    eng = make_engine(max_queue=1)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.05))
+        streams, shed = [], 0
+        for _ in range(12):              # 4 slots + 1 pending max
+            try:
+                streams.append(eng.submit([1], 8))
+            except ServingQueueFull:
+                shed += 1
+        assert shed >= 1
+        for s in streams:
+            assert len(s.tokens()) == 8  # accepted work still completes
+    assert counters.counter("decode.shed.queue").value >= 1
+
+
+def test_cancel_frees_blocks(make_engine, counters):
+    eng = make_engine()
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.02))
+        stream = eng.submit([3, 7, 1], 20)
+        first = next(stream)
+        stream.cancel()
+        tail = list(stream)
+    assert stream.finish_reason == "cancel"
+    assert 1 + len(tail) < 20
+    assert isinstance(first, int)
+    assert eng.cache.blocks_in_use() == 0
+
+
+def test_timeout_while_pending_frees_blocks(make_engine, counters):
+    eng = make_engine(decode_buckets=(1,), max_queue=8)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.03))
+        blocker = eng.submit([1], 10)    # owns the single slot
+        time.sleep(0.02)
+        late = eng.submit([2], 4, timeout=0.01)
+        with pytest.raises(RequestTimeout):
+            late.tokens()
+        assert blocker.tokens()          # the running one is unharmed
+    assert late.finish_reason == "timeout"
+    assert counters.counter("serving.timeouts").value == 1
+    assert eng.cache.blocks_in_use() == 0
+
+
+def test_close_without_drain_resolves_streams(make_engine):
+    eng = make_engine()
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.02))
+        stream = eng.submit([3, 7, 1], 20)
+        next(stream)
+        eng.close(drain=False)
+        with pytest.raises(ServableClosed):
+            list(stream)
+    assert stream.finish_reason == "closed"
+    assert eng.cache.blocks_in_use() == 0
+
+
+# ---------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------
+
+def test_decode_step_spans_under_request_root(make_engine):
+    obs.enable_tracing()
+    try:
+        eng = make_engine()
+        eng.submit([3, 7, 1], 5).tokens()
+        spans = obs.spans()
+    finally:
+        obs.disable_tracing()
+    roots = [s for s in spans if s["name"] == "serving.request"
+             and s["attrs"].get("generative")]
+    steps = [s for s in spans if s["name"] == "serving.decode_step"]
+    assert len(roots) == 1
+    assert roots[0]["attrs"]["tokens"] == 5
+    assert len(steps) == 5
+    assert {s["parent"] for s in steps} == {roots[0]["span"]}
+    assert {s["trace"] for s in steps} == {roots[0]["trace"]}
+    assert [s["attrs"]["token_index"] for s in steps] == list(range(5))
+
+
+# ---------------------------------------------------------------------
+# registry surface + hot swap
+# ---------------------------------------------------------------------
+
+def test_registry_generate_and_statusz_surface(registry, params):
+    sv = registry.register_generative("gpt", MODEL, params=params,
+                                      **ENGINE_KW)
+    assert "gpt" in registry
+    assert sv.queue_depth() == 0 and sv.queue_capacity == 16
+    assert sv.kvcache_stats()["blocks_in_use"] == 0
+    toks = registry.generate("gpt", [3, 7, 1], 5).tokens()
+    assert toks == _reference(params, [3, 7, 1], 5)
+
+
+def test_registry_generate_rejects_non_generative(registry):
+    from mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize(force_reinit=True)
+    net.hybridize()
+    net(mx.nd.array(np.zeros((1, 8), np.float32)))
+    registry.register("mlp", block=net, input_shape=(8,),
+                      buckets=(1, 2))
+    with pytest.raises(mx.MXNetError, match="not generative"):
+        registry.generate("mlp", [1, 2], 4)
+    with pytest.raises(mx.MXNetError):
+        registry.register_generative("both", MODEL)      # no source
+    with pytest.raises(mx.MXNetError):
+        registry.register_generative("both", MODEL, params={},
+                                     checkpoint="/nope")  # two sources
+
+
+def test_mid_decode_swap_drains_old_zero_dropped(registry, params,
+                                                counters):
+    p1 = MODEL.init_params(1)
+    registry.register_generative("gpt", MODEL, params=params,
+                                 **ENGINE_KW)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.decode.step",
+                 action=lambda ctx: time.sleep(0.03))
+        stream = registry.generate("gpt", [3, 7, 1, 9, 2], 20)
+        first = next(stream)             # mid-generation from here on
+        registry.register_generative("gpt", MODEL, params=p1,
+                                     **ENGINE_KW)
+        drained = [first] + list(stream)
+        # the half-generated sequence finished on the OLD weights
+        assert drained == _reference(params, [3, 7, 1, 9, 2], 20)
+        assert stream.finish_reason == "length"
+        assert chaos.stats()["survived"].get("serving.decode_swap") == 1
+        # new requests land on the new weights
+        assert registry.generate("gpt", [3, 7, 1], 5).tokens() \
+            == _reference(p1, [3, 7, 1], 5)
+    assert counters.counter(
+        "chaos.survived.serving.decode_swap").value == 1
+
+
+def test_swap_abort_leaves_old_serving(registry, params):
+    registry.register_generative("gpt", MODEL, params=params,
+                                 **ENGINE_KW)
+    with chaos.scenario(seed=0):
+        chaos.on("serving.swap", action=chaos.RAISE, times=1)
+        with pytest.raises(chaos.ChaosInjected):
+            registry.register_generative("gpt", MODEL,
+                                         params=MODEL.init_params(1),
+                                         **ENGINE_KW)
+    toks = registry.generate("gpt", [3, 7, 1], 5).tokens()
+    assert toks == _reference(params, [3, 7, 1], 5)
+
+
+def test_generative_watcher_swaps_on_new_step(registry, params,
+                                              tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+    p1 = MODEL.init_params(1)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(1, {"params": params})
+    w = GenerativeWatcher(registry, "gpt", mgr, MODEL, **ENGINE_KW)
+    assert w.poll_once() == 1
+    assert registry.generate("gpt", [3, 7, 1], 5).tokens() \
+        == _reference(params, [3, 7, 1], 5)
+    assert w.poll_once() is None         # nothing new
+    mgr.save(2, {"params": p1})
+    assert w.poll_once() == 2
+    assert registry.generate("gpt", [3, 7, 1], 5).tokens() \
+        == _reference(p1, [3, 7, 1], 5)
+    w.close()
