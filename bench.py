@@ -576,6 +576,87 @@ def bench_resnet50(batch_size=128, dtype="float32"):
                         batch_size, warmup=5, iters=20, dtype=dtype)
 
 
+def _hbm_sweep_step(batch):
+    """One compiled ResNet train step at ``batch`` (ResNet-50 NCHW on
+    TPU, the thumbnail ResNet-18 off-TPU so the sweep stays runnable in
+    dev); returns the executed TrainStep, whose ``_last_call`` carries
+    the (jitted fn, abstract args) pair hbm_plan anchors on."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import TrainStep
+    ctx = _ctx()
+    rng = np.random.RandomState(0)
+    if mx.num_tpus() > 0:
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+        net = resnet50_v1()
+        x_shape = (batch, 3, 224, 224)
+    else:
+        from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+        net = resnet18_v1(classes=10, thumbnail=True, layout="NHWC")
+        x_shape = (batch, 32, 32, 3)
+    net.initialize(ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     trainer, mesh=None)
+    x = mx.nd.array(rng.rand(*x_shape).astype(np.float32), ctx=ctx)
+    y = mx.nd.array(rng.randint(0, 10, (batch,)).astype(np.float32),
+                    ctx=ctx)
+    step(x, y)
+    return step
+
+
+def bench_batch_hbm_sweep(buckets=None, hbm_budget_bytes=None):
+    """ROADMAP item 1's "sweep batch at fixed HBM budget", as an
+    instrument (ISSUE 20): fit ``analysis.memory.hbm_plan``'s
+    const+per-item peak-HBM line from two anchor compiles of the
+    ResNet train step, then for EVERY bucket put the plan's predicted
+    peak next to the real compile's measured peak -- the emitted line
+    is the planner's accuracy contract, and ``largest_fit_bucket``
+    answers the ROADMAP question under the budget (the device's
+    reported HBM when it reports one, a 16 GB stand-in otherwise)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import memory as _memory
+    on_tpu = mx.num_tpus() > 0
+    if buckets is None:
+        buckets = (64, 128, 256, 512) if on_tpu else (2, 4, 8)
+    buckets = tuple(sorted(int(b) for b in buckets))
+    b0 = buckets[0]
+    if hbm_budget_bytes is None:
+        hbm_budget_bytes = _memory.device_hbm_bytes() or (16 << 30)
+    step = _hbm_sweep_step(b0)
+    fn, arg_shapes = step._last_call
+    plan = _memory.hbm_plan("bench:resnet-hbm-sweep",
+                            device_hbm_bytes=int(hbm_budget_bytes),
+                            buckets=buckets, batch_size=b0,
+                            fn=fn, args=arg_shapes)
+    rows = []
+    for brec in plan["buckets"]:
+        b = brec["batch"]
+        measured = _memory.executable_memory(
+            fn.lower(*_memory._resize_batch(arg_shapes, b0, b))
+            .compile())["peak_hbm_bytes"]
+        predicted = brec["predicted_peak_hbm_bytes"]
+        rows.append({
+            "batch": b,
+            "predicted_peak_hbm_bytes": predicted,
+            "measured_peak_hbm_bytes": measured,
+            "rel_error": (round((predicted - measured) / measured, 4)
+                          if measured else None),
+            "fits": brec["fits"],
+        })
+    return {
+        "probe": ("resnet50v1-nchw-sgd-224" if on_tpu
+                  else "resnet18v1-nhwc-sgd-thumbnail"),
+        "hbm_budget_bytes": int(hbm_budget_bytes),
+        "const_bytes": plan["const_bytes"],
+        "per_item_bytes": plan["per_item_bytes"],
+        "buckets": rows,
+        "largest_fit_bucket": plan["largest_fit_bucket"],
+    }
+
+
 # v5e bf16 peak; used only to contextualize throughput as MFU
 _TPU_PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5e": 197e12,
                    "TPU v5": 459e12, "TPU v4": 275e12}
@@ -1533,6 +1614,17 @@ def main():
                          "vs_baseline": None})
         except Exception as e:
             _print_line({"metric": "multichip_scaling",
+                         "error": str(e)[:200]})
+
+    # batch-at-fixed-HBM sweep (ISSUE 20 bench contract: ROADMAP
+    # item 1's sweep, predicted-vs-measured peak HBM per bucket)
+    if _budget_ok("batch_hbm_sweep", 180):
+        try:
+            rec = bench_batch_hbm_sweep()
+            _print_line({"metric": "batch_hbm_sweep", "unit": "bytes",
+                         "vs_baseline": None, **rec})
+        except Exception as e:
+            _print_line({"metric": "batch_hbm_sweep",
                          "error": str(e)[:200]})
 
     # serving tier: latency-vs-QPS curve (ISSUE 8 bench contract)

@@ -82,6 +82,19 @@
 #              convert storms, bf16 reductions) must show zero drift
 #              against the committed ci/numerics_baseline.json
 #              (mxlint --numerics-diff)
+#   memlint -> memory-pressure sanitizer gates (docs/memory.md): the
+#              full-tree static pass (five HBM-hazard rules armed:
+#              device-ref-accumulation, unbounded-shape-cache,
+#              host-materialize-large, retained-temp-across-step,
+#              feed-depth-unbounded), then a LeNet TrainStep smoke
+#              whose peak-HBM audit must show zero drift against the
+#              committed ci/memory_baseline.json (mxlint
+#              --memory-diff), a SEEDED +50% peak regression that must
+#              exit 1, an hbm_plan anchor check (predicted == compiled
+#              at both probe buckets), and the leak-sentinel gate
+#              under MXNET_TPU_MEMORY_WATCH=1 (seed 0): clean windows
+#              must never flag, chaos-pinned arrays must flag within
+#              3 windows naming the pinned shape bucket
 #   kernels -> Pallas kernel tier gates (docs/kernels.md): the
 #              interpret-mode kernel tests (registry policy, fused
 #              BN+ReLU numerics+vjp, flash op-level pallas path incl.
@@ -126,7 +139,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stages=("$@")
-[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint numlint kernels spmd serving serving_decode chaos chaos_dist obs fleet bench wheel)
+[ ${#stages[@]} -eq 0 ] && stages=(lint suite examples telemetry checkpoint tsan profiling perflint shardlint numlint memlint kernels spmd serving serving_decode chaos chaos_dist obs fleet bench wheel)
 
 log() { printf '\n== %s ==\n' "$1"; }
 
@@ -349,7 +362,7 @@ EOF
         tests/test_checkpoint.py tests/test_telemetry.py \
         tests/test_serving.py tests/test_chaos.py tests/test_obs.py \
         tests/test_resilience.py tests/test_numerics.py \
-        tests/test_fleet.py \
+        tests/test_memory.py tests/test_fleet.py \
         -q -m 'not slow'
     log "tsan: gloo multi-process tests under MXNET_TPU_TSAN=1"
     # the launched workers inherit the env, so the 2-/4-proc gloo SPMD
@@ -576,6 +589,139 @@ EOF
     python -m mxnet_tpu.analysis --numerics-diff \
         ci/numerics_baseline.json "$nmdir/current.json" --json
     rm -rf "$nmdir"
+}
+
+run_memlint() {
+    log "memlint: full-tree static pass (five HBM-hazard rules armed)"
+    # the memory rules ride the same framework as the lint stage;
+    # running --self here keeps this stage self-contained when invoked
+    # alone (ci/run_all.sh memlint)
+    python -m mxnet_tpu.analysis --self --json
+    log "memlint: peak-HBM audit + hbm_plan + leak-sentinel gate (LeNet TrainStep, seed 0)"
+    mmdir=$(mktemp -d /tmp/mxtpu_mem_ci.XXXXXX)
+    # PYTHONHASHSEED is pinned: hash ordering feeds the flattened
+    # argument order of the train step, and XLA's input-output alias
+    # assignment (alias_bytes, hence peak) depends on it -- the
+    # committed baseline is blessed under the same seed (docs/memory.md)
+    JAX_PLATFORMS=cpu MXNET_TPU_PROFILING=1 MXNET_TPU_MEMORY_WATCH=1 \
+        PYTHONHASHSEED=0 python - "$mmdir" <<'EOF'
+import os, sys
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import chaos, gluon, profiling
+from mxnet_tpu.analysis import memory
+from mxnet_tpu.parallel import TrainStep
+
+mmdir = sys.argv[1]
+assert profiling.enabled(), "MXNET_TPU_PROFILING=1 did not arm capture"
+assert memory.watch_enabled(), \
+    "MXNET_TPU_MEMORY_WATCH=1 did not arm the live-buffer watch"
+assert mx.runtime.Features().is_enabled("MEMORY_WATCH")
+
+
+class MemLeNet(gluon.nn.HybridSequential):
+    """Named so the audit row is stable across CI runs."""
+
+
+net = MemLeNet()
+net.add(gluon.nn.Conv2D(8, 5, padding=2, activation="relu",
+                        layout="NCHW"),
+        gluon.nn.MaxPool2D(2, layout="NCHW"),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(32, activation="relu"),
+        gluon.nn.Dense(10))
+net.initialize(ctx=mx.cpu())
+net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                   kvstore=None)
+step = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), tr,
+                 mesh=None)
+rng = np.random.RandomState(0)
+x = mx.nd.array(rng.rand(4, 1, 16, 16).astype(np.float32))
+y = mx.nd.array(rng.randint(0, 10, (4,)).astype(np.float32))
+for _ in range(2):
+    loss = step(x, y)
+loss.asnumpy()
+
+audit = memory.save_audit(os.path.join(mmdir, "current.json"))
+labels = set(audit["executables"])
+assert "train_step:MemLeNet" in labels, labels
+print("memlint audit ok: %d executables, %d advisories"
+      % (len(labels), len(audit["advisories"])))
+
+# hbm_plan anchor gate: the predicted peak at both probe buckets must
+# match a real compile -- the extrapolation line is anchored on real
+# compiles, so the planner cannot silently drift from the backend
+fn, arg_shapes = step._last_call
+plan = memory.hbm_plan(
+    "train_step:MemLeNet", buckets=(4, 8), batch_size=4,
+    fn=fn, args=arg_shapes,
+    device_hbm_bytes=memory.device_hbm_bytes() or (16 << 30))
+pred = {r["batch"]: r["predicted_peak_hbm_bytes"]
+        for r in plan["buckets"]}
+for b in (4, 8):
+    measured = memory.executable_memory(
+        fn.lower(*memory._resize_batch(arg_shapes, 4, b))
+        .compile())["peak_hbm_bytes"]
+    assert abs(pred[b] - measured) <= 1, (b, pred[b], measured)
+print("memlint hbm_plan ok: const %d B + %d B/item, largest fit %s"
+      % (plan["const_bytes"], plan["per_item_bytes"],
+         plan["largest_fit_bucket"]))
+
+# leak-sentinel gate (seed 0): clean windows must never flag;
+# chaos-pinned arrays must flag within 3 windows naming the pinned
+# shape bucket (the SENTINEL, not the injector, catches the leak)
+sent = memory.sentinel(window_steps=1, min_baseline=3,
+                       min_growth_frac=0.01)
+chaos.reset()
+chaos.on("memory.leak", memory.pin_action)
+for i in range(5):                      # disarmed: the point no-ops
+    chaos.fail_point("memory.leak", step=i)
+    sent.step()
+assert memory._STATE["leaks"] == 0, "clean windows flagged a leak"
+nbytes = int(memory._STATE["live_bytes"] * 0.3) + (16 << 20)
+chaos.arm(seed=0)
+flagged_at = None
+for i in range(6):
+    chaos.fail_point("memory.leak", step=i, nbytes=nbytes)
+    sent.step()
+    if memory._STATE["leaks"]:
+        flagged_at = i
+        break
+chaos.disarm()
+chaos.reset()
+assert flagged_at is not None and flagged_at < 3, \
+    "chaos-pinned growth not flagged within 3 windows"
+leak = memory._STATE["last_leak"]
+assert leak["bucket"] == "(%d,)/float32" % max(1, nbytes // 4), leak
+print("memlint sentinel ok: leak flagged at window %d naming %s "
+      "(+%d B)" % (flagged_at, leak["bucket"], leak["growth_bytes"]))
+EOF
+    # gate: peak HBM vs the committed baseline -- a grown peak or an
+    # unblessed executable/advisory exits 1 naming executable + kind;
+    # shrinkage passes
+    python -m mxnet_tpu.analysis --memory-diff \
+        ci/memory_baseline.json "$mmdir/current.json" --json
+    # the gate must also CATCH: a seeded +50% peak regression exits 1
+    python - "$mmdir" <<'EOF'
+import json, sys
+mmdir = sys.argv[1]
+with open(mmdir + "/current.json") as f:
+    cur = json.load(f)
+for row in cur["executables"].values():
+    row["metrics"]["peak_hbm_bytes"] = \
+        int(row["metrics"]["peak_hbm_bytes"] * 1.5)
+with open(mmdir + "/regress.json", "w") as f:
+    json.dump(cur, f)
+EOF
+    if python -m mxnet_tpu.analysis --memory-diff \
+        ci/memory_baseline.json "$mmdir/regress.json" --json \
+        > /dev/null; then
+        echo "memlint: seeded +50% peak-HBM regression was NOT caught"
+        exit 1
+    fi
+    echo "memlint: seeded peak regression caught (exit 1, as gated)"
+    rm -rf "$mmdir"
 }
 
 run_shardlint() {

@@ -40,6 +40,15 @@ device time is spent (docs/analysis.md):
   non-finite sentinel (:func:`finite_sentinel`,
   ``MXNET_TPU_NUMERICS_CHECK=1``) raising typed
   :class:`NonFiniteError` with first-offender attribution.
+- the memory-pressure sanitizer / hbmlint (docs/memory.md) -- five
+  static HBM-hazard rules (``device-ref-accumulation``,
+  ``unbounded-shape-cache``, ``host-materialize-large``,
+  ``retained-temp-across-step``, ``feed-depth-unbounded``), the
+  compiled peak-HBM audit :func:`memory_audit` gated against
+  ``ci/memory_baseline.json`` (``memory-drift``,
+  ``mxlint --memory-diff``) with :func:`hbm_plan` batch-bucket
+  extrapolation, and the runtime live-buffer leak sentinel
+  (``MXNET_TPU_MEMORY_WATCH=1``) over ``jax.live_arrays()``.
   ``mxlint --sarif`` exports every pass's findings as SARIF 2.1.0.
 
 CLI: ``python -m mxnet_tpu.analysis`` (or the ``mxlint`` entry point);
@@ -63,6 +72,10 @@ from .perf import (audit_hlo_text, diff_audit, load_audit, perf_audit,
 from . import numerics
 from .numerics import (NonFiniteError, finite_sentinel, finite_tree,
                        numerics_audit)
+# memory shares the save/load/diff_audit spelling too; reach them as
+# analysis.memory.save_audit etc.
+from . import memory
+from .memory import hbm_plan, memory_audit
 from . import sarif
 from .sarif import to_sarif, write_sarif
 from .cli import main
@@ -79,6 +92,7 @@ __all__ = [
     "save_audit",
     "numerics", "NonFiniteError", "finite_sentinel", "finite_tree",
     "numerics_audit",
+    "memory", "hbm_plan", "memory_audit",
     "sarif", "to_sarif", "write_sarif",
     "main",
 ]

@@ -34,10 +34,11 @@ def _build_parser():
         prog="mxlint",
         description="Static graph checker + trace-safety linter + "
                     "concurrency sanitizer + sharding sanitizer + "
-                    "perf linter + numerics sanitizer + retrace "
-                    "auditor for mxnet_tpu (docs/analysis.md, "
-                    "docs/sharding.md, docs/perf_lint.md, "
-                    "docs/numerics.md).")
+                    "perf linter + numerics sanitizer + memory "
+                    "sanitizer + retrace auditor for mxnet_tpu "
+                    "(docs/analysis.md, docs/sharding.md, "
+                    "docs/perf_lint.md, docs/numerics.md, "
+                    "docs/memory.md).")
     ap.add_argument("paths", nargs="*",
                     help="files or directories to lint")
     ap.add_argument("--self", dest="self_check", action="store_true",
@@ -87,6 +88,13 @@ def _build_parser():
                          "grown half-accum-dot/convert-storm/"
                          "half-reduce shares or unblessed advisories "
                          "-- the CI numlint gate (docs/numerics.md)")
+    ap.add_argument("--memory-diff", nargs=2,
+                    metavar=("BASELINE", "CURRENT"),
+                    help="diff two memory-audit JSONs (written by "
+                         "analysis.memory.save_audit) and fail on "
+                         "grown peak HBM or unblessed executables/"
+                         "advisories -- the CI memlint gate "
+                         "(docs/memory.md)")
     ap.add_argument("--sarif", metavar="OUT",
                     help="also write surviving findings (every pass) "
                          "as a SARIF 2.1.0 log for CI annotation; "
@@ -169,8 +177,8 @@ def _write_baseline(path, diags: List[Diagnostic]):
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     # importing the passes registers their rules
-    from . import (concurrency, graph_check, numerics, perf, retrace,
-                   sharding, trace_lint)
+    from . import (concurrency, graph_check, memory, numerics, perf,
+                   retrace, sharding, trace_lint)
 
     if args.list_rules:
         print(_list_rules())
@@ -269,9 +277,22 @@ def main(argv=None) -> int:
         diags.extend(d for d in numerics.diff_audit(base, cur)
                      if d.rule not in ignore)
 
+    if args.memory_diff:
+        base_path, cur_path = args.memory_diff
+        try:
+            base = memory.load_audit(base_path)
+            cur = memory.load_audit(cur_path)
+        except (OSError, ValueError, KeyError) as e:
+            print("mxlint: cannot read memory audit: %s" % e,
+                  file=sys.stderr)
+            return 2
+        diags.extend(d for d in memory.diff_audit(base, cur)
+                     if d.rule not in ignore)
+
     if not paths and not args.graph and not run_retrace \
             and not args.changed and not args.collective_diff \
-            and not args.perf_diff and not args.numerics_diff:
+            and not args.perf_diff and not args.numerics_diff \
+            and not args.memory_diff:
         _build_parser().print_usage()
         return 2
 

@@ -905,8 +905,12 @@ def finite_tree(leaves):
     return ok
 
 
-# eager twin: one cached jitted program per (shape, dtype) signature
+# eager twin: one cached jitted program per (shape, dtype) signature,
+# bounded -- a sentinel wrapped around ever-changing shapes must not
+# itself leak one executable per novel signature (the very hazard the
+# memory pass's unbounded-shape-cache rule lints for)
 _FUSED_CACHE: Dict[tuple, object] = {}
+_FUSED_CACHE_CAP = 64
 
 
 def finite_all(arrays):
@@ -924,6 +928,8 @@ def finite_all(arrays):
     key = tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
+        while len(_FUSED_CACHE) >= _FUSED_CACHE_CAP:
+            _FUSED_CACHE.pop(next(iter(_FUSED_CACHE)))
         fn = jax.jit(lambda *xs: finite_tree(list(xs)))
         _FUSED_CACHE[key] = fn
     return fn(*arrs)

@@ -301,6 +301,22 @@ _VARS = [
            "/ analysis.numerics.diff_audit).  A metric grown past "
            "baseline + tolerance errors naming the executable; "
            "improvements pass (docs/numerics.md)."),
+    EnvVar("MXNET_TPU_MEMORY_WATCH", bool, False,
+           "'1' arms the live-buffer leak sentinel "
+           "(analysis.memory.LeakSentinel): ContinuousTrainer censuses "
+           "jax.live_arrays() at every goodput-window boundary "
+           "(memory.live_bytes / memory.live_arrays gauges) and flags "
+           "monotonic live-bytes growth past the EWMA+MAD baseline, "
+           "naming the top-growing shape/dtype bucket -- "
+           "publish-guarded, so checkpoint snapshot spikes never "
+           "flag.  '0' (default): one module-flag check, zero "
+           "per-step work (docs/memory.md)."),
+    EnvVar("MXNET_TPU_MEMORY_AUDIT_TOL", float, 0.02,
+           "Relative growth tolerance for peak_hbm_bytes when diffing "
+           "a memory audit against the blessed ci/memory_baseline.json "
+           "(mxlint --memory-diff / analysis.memory.diff_audit).  An "
+           "executable whose peak HBM grew past baseline x (1 + tol) "
+           "errors naming it; shrinkage passes (docs/memory.md)."),
     EnvVar("MXNET_TPU_CKPT_QUARANTINE", bool, True,
            "Checkpoint discovery quarantine: a step that fails "
            "manifest/CRC verification during "

@@ -702,7 +702,13 @@ def _eager_jit_fn(op, params, present, total_args):
             return fcompute(*full, **kwargs)
 
         entry = (jax.jit(f), f, stateful)
-        _EAGER_JIT_CACHE[sig] = entry
+        # suppression invariant: sig space = op set x call arities x
+        # static-param values actually used -- program-bounded, and a
+        # recorded backward resolves its forward through this table
+        # (_eager_bwd_fn), so LRU eviction here would KeyError an
+        # in-flight autograd tape.  Retrace growth is observable via
+        # the compile_event cache_size payload instead.
+        _EAGER_JIT_CACHE[sig] = entry  # mxlint: disable=unbounded-shape-cache
         if _telemetry._ENABLED:
             _emit_eager_compile(sig)
     return entry[0], dyn_names, sig
@@ -759,7 +765,10 @@ def _eager_bwd_fn(sig):
             return pull(cts)
 
         bwd = jax.jit(b)
-        _EAGER_BWD_CACHE[sig] = bwd
+        # suppression invariant: strictly a subset of _EAGER_JIT_CACHE's
+        # sig space (only recorded ops), bounded by the same program
+        # invariant documented there.
+        _EAGER_BWD_CACHE[sig] = bwd  # mxlint: disable=unbounded-shape-cache
     return bwd
 
 

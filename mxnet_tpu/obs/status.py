@@ -208,6 +208,11 @@ def statusz():
         numerics_row = _numerics.status_row()
     except Exception:
         numerics_row = None
+    try:
+        from ..analysis import memory as _memory
+        memory_row = _memory.status_row()
+    except Exception:
+        memory_row = None
     fleet_row = None
     mon = fleet_monitor()
     if mon is not None:
@@ -242,6 +247,9 @@ def statusz():
         # the non-finite sentinel: armed?, checks run, nonfinite steps
         # seen, last attribution (analysis.numerics, docs/numerics.md)
         "numerics": numerics_row,
+        # the live-buffer leak sentinel: armed?, censuses run, live
+        # totals, leaks flagged (analysis.memory, docs/memory.md)
+        "memory": memory_row,
         "heartbeats": dict(_heartbeats),
         # replicas up/down + firing-alert count when a FleetMonitor
         # runs here (obs.fleet, ISSUE 17)

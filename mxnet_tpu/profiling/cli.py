@@ -86,6 +86,12 @@ def _collect(paths, dirpath):
             merged["totals"]["flops"] += r["totals"]["flops"]
             merged["totals"]["bytes_accessed"] += \
                 r["totals"]["bytes_accessed"]
+            # peak HBM merges as MAX, not sum: reports come from
+            # separate dispatches whose live sets never coexist, so the
+            # combined peak is the worst single program's peak (the
+            # same convention as store.combined() and the memory
+            # auditor's same-label merge; asserted by
+            # test_memory.test_mxprof_merge_peak_is_max)
             merged["totals"]["peak_hbm_bytes"] = max(
                 merged["totals"]["peak_hbm_bytes"],
                 r["totals"]["peak_hbm_bytes"])
@@ -152,6 +158,12 @@ def _render_report(comb):
                                  "time share %5.1f%%"
                                  % (cat, cv["bound"],
                                     100 * cv["time_share"]))
+    lines.append("")
+    lines.append("totals: flops %s  bytes %s  peak HBM %s (max over "
+                 "executables; peaks of separate dispatches never add)"
+                 % (_fmt_flops(comb["totals"]["flops"]),
+                    _fmt_bytes(comb["totals"]["bytes_accessed"]),
+                    _fmt_bytes(comb["totals"]["peak_hbm_bytes"])))
     if comb["categories"]:
         tf = max(comb["totals"]["flops"], 1.0)
         tb = max(comb["totals"]["bytes_accessed"], 1.0)

@@ -67,6 +67,10 @@ def _detect():
         # MXNET_TPU_NUMERICS_CHECK armed the fused per-step isfinite
         # check + first-offender attribution for this run
         "NUMERICS": _numerics_check_enabled(),
+        # live-buffer leak sentinel (analysis.memory): whether
+        # MXNET_TPU_MEMORY_WATCH armed the per-window live-array
+        # census + leak sentinel for this run
+        "MEMORY_WATCH": _memory_watch_enabled(),
         # request/step tracing (mx.obs): LIVE arm state, same contract
         # as the TELEMETRY row
         "OBS_TRACE": _obs_tracing(),
@@ -135,6 +139,14 @@ def _numerics_check_enabled():
     # drag the whole lint stack into feature probing
     import os
     return os.environ.get("MXNET_TPU_NUMERICS_CHECK", "0") != "0"
+
+
+def _memory_watch_enabled():
+    # env-read directly (analysis.memory.watch_enabled() reads the
+    # same variable at import); importing mxnet_tpu.analysis here would
+    # drag the whole lint stack into feature probing
+    import os
+    return os.environ.get("MXNET_TPU_MEMORY_WATCH", "0") != "0"
 
 
 def _try_import(mod):

@@ -96,6 +96,26 @@ class BucketExecutorPool:
                                             len(self.buckets))
         return dt
 
+    def hbm_plan(self, device_hbm_bytes=None):
+        """Predict peak HBM per bucket (``analysis.memory.hbm_plan``):
+        two real compiles anchored at the smallest bucket fit the
+        const+per-item line, every bucket is extrapolated along it, and
+        ``largest_fit_bucket`` answers what ``device_hbm_bytes`` can
+        actually serve.  Compiles hit jax's executable cache when the
+        buckets are already warm."""
+        import jax
+        from ..analysis import memory as _memory
+        pspecs = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for n, v in self._params.items()}
+        b0 = self.buckets[0]
+        xspec = jax.ShapeDtypeStruct((b0,) + self.input_shape,
+                                     self.dtype)
+        return _memory.hbm_plan(
+            "serving:%s" % self._label,
+            device_hbm_bytes=device_hbm_bytes, buckets=self.buckets,
+            batch_size=b0, fn=jax.jit(self._fn),
+            args=(pspecs, xspec))
+
     def _build(self, bucket):
         import jax
         if bucket in self._compiled:

@@ -148,6 +148,7 @@ class ContinuousTrainer:
             try:
                 x, y = self._data(step) if callable(self._data) \
                     else self._data
+                from ..analysis import memory as _memory
                 from ..analysis import numerics as _numerics
                 from .. import chaos as _chaos
                 # numerics.nonfinite chaos point: poison THIS batch so
@@ -156,6 +157,10 @@ class ContinuousTrainer:
                 _box = {}
                 _chaos.fail_point("numerics.nonfinite", box=_box,
                                   step=step)
+                # memory.leak chaos point: the armed action pins device
+                # arrays in a hidden list, so the LEAK SENTINEL (not
+                # the injector) must catch the live-bytes growth
+                _chaos.fail_point("memory.leak", step=step)
                 if _box.get("poison"):
                     x = _numerics.poison_nd(x)
                 with autograd.record():
@@ -184,6 +189,10 @@ class ContinuousTrainer:
                 # the MXNET_TPU_OBS_GOODPUT_WINDOW boundary and the
                 # attribution publishes through goodput.* instruments
                 _obs.goodput.ledger().step()
+            if _memory.watch_enabled():
+                # one sentinel tick per step: live-array censuses run
+                # only at window boundaries, inside the sentinel
+                _memory.sentinel().step()
             # liveness beat for /statusz: a stale heartbeat means a
             # wedged loop even when every thread is technically alive
             _obs.status.heartbeat()
@@ -226,6 +235,11 @@ class ContinuousTrainer:
             # the ledger's publish guard: the checkpoint_stall spike
             # this window is expected work, not a regression
             _obs.goodput.ledger().note_publish()
+        from ..analysis import memory as _memory
+        if _memory.watch_enabled():
+            # same guard for the leak sentinel: the snapshot's
+            # live-bytes spike is expected work, not a leak
+            _memory.sentinel().note_publish()
         if _telemetry._ENABLED:
             _telemetry.hooks.train_publish(step,
                                            time.perf_counter() - t0)
@@ -271,6 +285,10 @@ class ContinuousTrainer:
             # close the partial tail window so a short run still
             # reports its attribution
             _obs.goodput.ledger().flush(reason="close")
+        from ..analysis import memory as _memory
+        if _memory.watch_enabled():
+            # close the sentinel's partial tail window too
+            _memory.sentinel().flush()
         with self._lock:
             err, self._error = self._error, None
         if err is not None:

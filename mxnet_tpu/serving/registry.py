@@ -192,6 +192,7 @@ class ModelRegistry:
             finally:
                 if _w is not None:
                     _obs.end_span(_w)
+            self._validate_hbm(name, pool)
         # chaos: an abort here (after the expensive warm-up, before the
         # install) models every way a swap dies late; the previous
         # servable MUST keep serving untouched -- the watcher's
@@ -215,6 +216,33 @@ class ModelRegistry:
         if _telemetry._ENABLED:
             _telemetry.hooks.serving_model(name, source, len(buckets))
         return servable
+
+    def _validate_hbm(self, name, pool):
+        """HBM bucket validation (ISSUE 20): when the backend reports a
+        device memory limit, predict every bucket's peak HBM along the
+        hbm_plan line and warn on buckets that cannot fit --
+        registration still succeeds (an oversized bucket may never be
+        dispatched), but the operator hears it BEFORE an OOM does the
+        telling.  No-op on backends without memory_stats (CPU)."""
+        from ..analysis import memory as _memory
+        limit = _memory.device_hbm_bytes()
+        if not limit:
+            return None
+        try:
+            plan = pool.hbm_plan(limit)
+        except Exception:
+            return None             # planning must never block a swap
+        bad = [str(b["batch"]) for b in plan["buckets"]
+               if b["fits"] is False]
+        if bad:
+            import warnings
+            warnings.warn(
+                "servable %r: predicted peak HBM exceeds the device "
+                "limit for bucket(s) %s (largest fitting bucket: %s); "
+                "see analysis.memory.hbm_plan / docs/memory.md"
+                % (name, ", ".join(bad), plan["largest_fit_bucket"]),
+                RuntimeWarning, stacklevel=3)
+        return plan
 
     def register_generative(self, name, model, params=None,
                             checkpoint=None, step=None,

@@ -27,6 +27,7 @@ __all__ = [
     "samples_per_sec", "kv_op", "dataloader_wait", "feed_produce",
     "feed_wait", "feed_overlap", "amp_overflow", "amp_rescale",
     "numerics_check", "numerics_nonfinite",
+    "memory_census", "memory_leak",
     "checkpoint", "checkpoint_wait",
     "sync_contention", "sync_hold", "sync_watchdog", "sync_inversion",
     "profiling_capture", "profiling_step",
@@ -183,6 +184,25 @@ def numerics_nonfinite(param, step, kind):
     reg.counter("numerics.nonfinite_steps").inc()
     reg.event("numerics.nonfinite").emit(param=param, step=step,
                                          kind=kind)
+
+
+def memory_census(live_bytes, live_arrays):
+    """One live-buffer census ran (analysis.memory; armed by
+    MXNET_TPU_MEMORY_WATCH=1): publish the live totals as gauges."""
+    reg = _registry()
+    reg.counter("memory.censuses").inc()
+    reg.gauge("memory.live_bytes").set(live_bytes)
+    reg.gauge("memory.live_arrays").set(live_arrays)
+
+
+def memory_leak(bucket, growth_bytes, live_bytes, window):
+    """The leak sentinel flagged monotonic live-bytes growth; payload
+    names the top-growing shape/dtype bucket."""
+    reg = _registry()
+    reg.counter("memory.leaks").inc()
+    reg.event("memory.leak").emit(bucket=bucket,
+                                  growth_bytes=growth_bytes,
+                                  live_bytes=live_bytes, window=window)
 
 
 def checkpoint(action, nbytes=None, seconds=None, **payload):
@@ -714,6 +734,18 @@ INSTRUMENTS = [
     _ii("numerics.nonfinite", "event", "numerics", 16,
         "one per attributed non-finite step; payload names the first "
         "offending parameter, the step, and nan-vs-inf"),
+    _ii("memory.censuses", "counter", "memory", 19,
+        "live-buffer censuses run (MXNET_TPU_MEMORY_WATCH=1)"),
+    _ii("memory.live_bytes", "gauge", "memory", 19,
+        "total bytes of jax.live_arrays() at the last census"),
+    _ii("memory.live_arrays", "gauge", "memory", 19,
+        "live device-array count at the last census"),
+    _ii("memory.leaks", "counter", "memory", 19,
+        "windows the leak sentinel flagged monotonic live-bytes "
+        "growth on"),
+    _ii("memory.leak", "event", "memory", 19,
+        "one per flagged leak window; payload names the top-growing "
+        "shape/dtype bucket, the growth bytes, and the window index"),
     _ii("checkpoint", "event", "checkpoint", 2,
         "checkpoint save/restore; payload carries step/bytes/duration"),
     _ii("checkpoint.saves", "counter", "checkpoint", 3,

@@ -53,6 +53,18 @@ def bench_mod(monkeypatch):
                         lambda *a, **k: (1500.0, 5000.0, {}))
     monkeypatch.setattr(bench, "_cpu_subprocess_value",
                         lambda *a, **k: 1000.0)
+    monkeypatch.setattr(bench, "bench_batch_hbm_sweep",
+                        lambda *a, **k: {
+                            "probe": "resnet50v1-nchw-sgd-224",
+                            "hbm_budget_bytes": 16 << 30,
+                            "const_bytes": 98000000,
+                            "per_item_bytes": 2000000,
+                            "buckets": [
+                                {"batch": 64,
+                                 "predicted_peak_hbm_bytes": 226000000,
+                                 "measured_peak_hbm_bytes": 230000000,
+                                 "rel_error": -0.0174, "fits": True}],
+                            "largest_fit_bucket": 64})
     monkeypatch.setattr(bench, "_multichip_scaling_rows",
                         lambda *a, **k: [
                             {"n_devices": 1, "img_per_s": 1000.0,
@@ -209,6 +221,39 @@ def test_budget_exhaustion_skips_garnish_only(bench_mod, capsys,
     assert not names & {"resnet50_imagenet_train_bf16_scan",
                         "bert_base_pretrain_bfloat16",
                         "resnet50_imagenet_train", "env_health"}
+
+
+def test_batch_hbm_sweep_line_contract(bench_mod, capsys):
+    """ISSUE 20 bench contract (ROADMAP item 1's sweep): the
+    batch_hbm_sweep line carries predicted-vs-measured peak HBM per
+    bucket, the fitted const/per-item line, the budget, the largest
+    fitting bucket -- and the degraded_env flag like every line."""
+    bench_mod.main()
+    _names, lines = _metrics(capsys)
+    by = {ln["metric"]: ln for ln in lines}
+    rec = by["batch_hbm_sweep"]
+    assert "degraded_env" in rec
+    assert rec["hbm_budget_bytes"] > 0
+    assert rec["const_bytes"] >= 0 and rec["per_item_bytes"] >= 0
+    for b in rec["buckets"]:
+        assert {"batch", "predicted_peak_hbm_bytes",
+                "measured_peak_hbm_bytes", "rel_error",
+                "fits"} <= set(b)
+    assert rec["largest_fit_bucket"] == 64
+
+
+def test_batch_hbm_sweep_is_hbm_plan_driven(monkeypatch):
+    """The sweep's predictions must come from analysis.memory.hbm_plan
+    and its measurements from executable_memory (the planner's accuracy
+    contract) -- not bench-local extrapolation.  Uses the UNPATCHED
+    module (the bench_mod fixture stubs the function)."""
+    import inspect
+    monkeypatch.syspath_prepend(REPO)
+    import bench
+    src = inspect.getsource(bench.bench_batch_hbm_sweep)
+    assert "hbm_plan" in src
+    assert "executable_memory" in src
+    assert "device_hbm_bytes" in src
 
 
 def test_e2e_runs_on_library_device_feed(bench_mod):

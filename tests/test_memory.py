@@ -1,0 +1,692 @@
+"""hbmlint (ISSUE 20): per-rule static fixtures for the five
+HBM-hazard rules, the compiled peak-HBM audit contract (registry walk,
+same-label merge, baseline round trip, schema reject), the hbm_plan
+batch-bucket extrapolation against real compiles, the SARIF export,
+the mxprof max-of-peaks merge convention, and the live-buffer leak
+sentinel: zero-touch when disarmed, chaos-pinned growth flagged within
+three windows when armed, publish-guarded windows neither judged nor
+taught."""
+import json
+import os
+
+import pytest
+
+import jax.numpy as jnp
+
+from mxnet_tpu import chaos
+from mxnet_tpu import analysis as an
+from mxnet_tpu.analysis import memory
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MEM_RULES = {"device-ref-accumulation", "unbounded-shape-cache",
+             "host-materialize-large", "retained-temp-across-step",
+             "feed-depth-unbounded"}
+
+
+def _lint(src):
+    return an.lint_source(src, "probe.py")
+
+
+def _mem(diags):
+    """The memory-rule subset -- fixtures may legitimately trip other
+    passes (a jit without donation is also PR 7's business)."""
+    return sorted({d.rule for d in diags if d.rule in MEM_RULES})
+
+
+@pytest.fixture(autouse=True)
+def _memory_state():
+    """Snapshot/restore the watch flag, /statusz counters, sentinel,
+    and chaos-pinned arrays."""
+    prev_watch = memory._WATCH
+    prev_state = dict(memory._STATE)
+    prev_sentinel = memory._SENTINEL
+    yield
+    memory._WATCH = prev_watch
+    memory._STATE.clear()
+    memory._STATE.update(prev_state)
+    memory._SENTINEL = prev_sentinel
+    memory._PINNED.clear()
+
+
+# ----------------------------------------------------------------------
+# static rules: one positive and one negative fixture per rule
+# ----------------------------------------------------------------------
+
+def test_device_ref_accumulation_fires_and_host_scalar_silent():
+    bad = (
+        "def train(step, data):\n"
+        "    losses = []\n"
+        "    for x, y in data:\n"
+        "        loss = step(x, y)\n"
+        "        losses.append(loss)\n"
+    )
+    diags = [d for d in _lint(bad)
+             if d.rule == "device-ref-accumulation"]
+    assert len(diags) == 1
+    assert diags[0].line == 5
+    assert "float(x)" in diags[0].message
+    assert "deque(maxlen=N)" in diags[0].message
+    good = (
+        "def train(step, data):\n"
+        "    losses = []\n"
+        "    for x, y in data:\n"
+        "        loss = step(x, y)\n"
+        "        losses.append(float(loss))\n"
+    )
+    assert "device-ref-accumulation" not in _mem(_lint(good))
+    # outside a training loop the accumulation is someone's business,
+    # not this rule's
+    eager = (
+        "def collect(make, n):\n"
+        "    outs = []\n"
+        "    for i in range(n):\n"
+        "        outs.append(make(i))\n"
+    )
+    assert _mem(_lint(eager)) == []
+
+
+def test_device_ref_accumulation_augassign_and_derived_taint():
+    bad = (
+        "def train(step, data):\n"
+        "    hist = []\n"
+        "    for x, y in data:\n"
+        "        loss = step(x, y)\n"
+        "        smooth = loss\n"        # taint flows through reuse
+        "        hist += [smooth]\n"
+    )
+    assert "device-ref-accumulation" in _mem(_lint(bad))
+
+
+def test_unbounded_shape_cache_fires_and_evicting_silent():
+    bad = (
+        "_CACHE = {}\n"
+        "def compiled_for(x, build):\n"
+        "    key = (x.shape, str(x.dtype))\n"
+        "    if key not in _CACHE:\n"
+        "        _CACHE[key] = build(x)\n"
+        "    return _CACHE[key]\n"
+    )
+    diags = [d for d in _lint(bad) if d.rule == "unbounded-shape-cache"]
+    assert len(diags) == 1
+    assert "'_CACHE'" in diags[0].message
+    # setdefault keyed on a sig-named expression fires too
+    sd = (
+        "_PROGRAMS = dict()\n"
+        "def get(sig, make):\n"
+        "    return _PROGRAMS.setdefault(sig, make())\n"
+    )
+    assert "unbounded-shape-cache" in _mem(_lint(sd))
+    # an eviction bound anywhere in the file clears the cache
+    good = (
+        "_CACHE = {}\n"
+        "def compiled_for(x, build):\n"
+        "    key = (x.shape, str(x.dtype))\n"
+        "    while len(_CACHE) >= 64:\n"
+        "        _CACHE.pop(next(iter(_CACHE)))\n"
+        "    _CACHE[key] = build(x)\n"
+        "    return _CACHE[key]\n"
+    )
+    assert _mem(_lint(good)) == []
+    # a dict not keyed on shape/dtype is not this rule's business
+    named = (
+        "_BY_NAME = {}\n"
+        "def register(name, obj):\n"
+        "    _BY_NAME[name] = obj\n"
+    )
+    assert _mem(_lint(named)) == []
+
+
+def test_host_materialize_large_fires_and_small_or_hoisted_silent():
+    bad = (
+        "def monitor(n, nd):\n"
+        "    big = nd.zeros((2048, 2048))\n"
+        "    for i in range(n):\n"
+        "        snap = big.asnumpy()\n"
+    )
+    diags = [d for d in _lint(bad) if d.rule == "host-materialize-large"]
+    assert len(diags) == 1
+    assert "'big'" in diags[0].message and "4,194,304" in diags[0].message
+    # small tensors and hoisted materialization stay silent
+    small = (
+        "def monitor(n, nd):\n"
+        "    little = nd.zeros((64, 64))\n"
+        "    for i in range(n):\n"
+        "        snap = little.asnumpy()\n"
+    )
+    assert _mem(_lint(small)) == []
+    hoisted = (
+        "def monitor(n, nd):\n"
+        "    big = nd.zeros((2048, 2048))\n"
+        "    snap = big.asnumpy()\n"
+        "    for i in range(n):\n"
+        "        use(snap)\n"
+    )
+    assert _mem(_lint(hoisted)) == []
+
+
+def test_retained_temp_across_step_fires_and_donated_silent():
+    bad = (
+        "import jax\n"
+        "step = jax.jit(update)\n"
+        "class Loop:\n"
+        "    def run(self, data):\n"
+        "        for x, y in data:\n"
+        "            self.state = step(x, y)\n"
+    )
+    diags = [d for d in _lint(bad)
+             if d.rule == "retained-temp-across-step"]
+    assert len(diags) == 1
+    assert "self.state" in diags[0].message
+    assert "donate_argnums" in diags[0].message
+    donated = (
+        "import jax\n"
+        "step = jax.jit(update, donate_argnums=(0,))\n"
+        "class Loop:\n"
+        "    def run(self, data):\n"
+        "        for x, y in data:\n"
+        "            self.state = step(x, y)\n"
+    )
+    assert "retained-temp-across-step" not in _mem(_lint(donated))
+    released = (
+        "import jax\n"
+        "step = jax.jit(update)\n"
+        "class Loop:\n"
+        "    def run(self, data):\n"
+        "        for x, y in data:\n"
+        "            del self.state\n"
+        "            self.state = step(x, y)\n"
+    )
+    assert "retained-temp-across-step" not in _mem(_lint(released))
+
+
+def test_feed_depth_unbounded_fires_and_bounded_silent():
+    bad = (
+        "import collections\n"
+        "import queue\n"
+        "class Feeder:\n"
+        "    def __init__(self):\n"
+        "        self.feed_q = collections.deque()\n"
+        "        self.prefetch = queue.Queue()\n"
+    )
+    diags = [d for d in _lint(bad) if d.rule == "feed-depth-unbounded"]
+    assert len(diags) == 2
+    msgs = "\n".join(d.message for d in diags)
+    assert "'feed_q'" in msgs and "'prefetch'" in msgs
+    assert "MXNET_TPU_FEED_DEPTH" in msgs
+    # ctor bounds are the blessed form
+    good = (
+        "import collections\n"
+        "import queue\n"
+        "class Feeder:\n"
+        "    def __init__(self, depth):\n"
+        "        self.feed_q = collections.deque(maxlen=depth)\n"
+        "        self.prefetch = queue.Queue(maxsize=depth)\n"
+    )
+    assert _mem(_lint(good)) == []
+    # a len() shed check anywhere in the file bounds as surely as a
+    # ctor maxlen (the serving batcher's pattern)
+    shed = (
+        "import collections\n"
+        "class Feeder:\n"
+        "    def __init__(self):\n"
+        "        self.feed_q = collections.deque()\n"
+        "    def put(self, item):\n"
+        "        if len(self.feed_q) >= 8:\n"
+        "            raise RuntimeError('full')\n"
+        "        self.feed_q.append(item)\n"
+    )
+    assert _mem(_lint(shed)) == []
+
+
+def test_feed_depth_device_staging_evidence_gates_plain_names():
+    # a neutrally-named deque is gated only when the scope stages
+    # device arrays into it
+    staging = (
+        "import collections\n"
+        "def producer(batches):\n"
+        "    buf = collections.deque()\n"
+        "    buf.append(jnp.zeros((4,)))\n"
+    )
+    assert "feed-depth-unbounded" in _mem(_lint(staging))
+    plain = (
+        "import collections\n"
+        "def producer(items):\n"
+        "    buf = collections.deque()\n"
+        "    buf.append(items[0])\n"
+    )
+    assert _mem(_lint(plain)) == []
+
+
+def test_memory_rules_registered_and_suppressible():
+    from mxnet_tpu.analysis import core
+    for rid in sorted(MEM_RULES):
+        assert core.RULES[rid].kind == "ast"
+        assert core.RULES[rid].doc
+    assert core.RULES["memory-drift"].kind == "compiled"
+    suppressed = (
+        "_CACHE = {}\n"
+        "def compiled_for(x, build):\n"
+        "    key = (x.shape, str(x.dtype))\n"
+        "    _CACHE[key] = build(x)  "
+        "# mxlint: disable=unbounded-shape-cache\n"
+        "    return _CACHE[key]\n"
+    )
+    assert _mem(_lint(suppressed)) == []
+
+
+# ----------------------------------------------------------------------
+# compiled layer: registry walk, same-label merge, baseline round trip
+# ----------------------------------------------------------------------
+
+def _register_toy(label, fn, *args):
+    import jax
+    from mxnet_tpu.profiling import store
+    jfn = jax.jit(fn)
+    jfn(*args)
+    store.register((label,), label, jfn, args)
+    return jfn
+
+
+def test_memory_audit_registry_walk():
+    from mxnet_tpu import profiling
+    profiling.reset()
+    _register_toy("toy:memaudit",
+                  lambda a, b: (a @ b).sum(axis=0),
+                  jnp.ones((64, 64), jnp.float32),
+                  jnp.ones((64, 64), jnp.float32))
+    audit = memory.memory_audit()
+    assert audit["schema"] == memory.AUDIT_SCHEMA == "mxmemory.audit.v1"
+    assert audit["thresholds"]["temp_args_factor"] == 2.0
+    m = audit["executables"]["toy:memaudit"]["metrics"]
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "donatable_bytes", "peak_hbm_bytes",
+                "temp_share", "alias_coverage"):
+        assert key in m
+    assert m["argument_bytes"] >= 2 * 64 * 64 * 4
+    # the peak identity the planner and the drift gate both lean on
+    assert m["peak_hbm_bytes"] == max(
+        0, m["argument_bytes"] + m["output_bytes"] + m["temp_bytes"]
+        - m["alias_bytes"])
+    # ranked advisories carry the executable label
+    for a in audit["advisories"]:
+        assert "executable" in a and "kind" in a and "share" in a
+    profiling.reset()
+
+
+def test_memory_audit_same_label_merge_sums_bytes_peak_is_max():
+    import jax
+    from mxnet_tpu import profiling
+    from mxnet_tpu.profiling import store
+    profiling.reset()
+    f1, x1 = jax.jit(lambda a: a * 2.0), jnp.ones((128, 128))
+    f2, x2 = jax.jit(lambda a: a + 1.0), jnp.ones((32, 32))
+    f1(x1), f2(x2)
+    store.register(("merge", 1), "toy:merge", f1, (x1,))
+    store.register(("merge", 2), "toy:merge", f2, (x2,))
+    p1 = memory.executable_memory(f1.lower(x1).compile())
+    p2 = memory.executable_memory(f2.lower(x2).compile())
+    m = memory.memory_audit()["executables"]["toy:merge"]["metrics"]
+    # two programs under one label: byte totals SUM, peak takes MAX --
+    # distinct dispatches' live sets never coexist
+    assert m["argument_bytes"] == \
+        p1["argument_bytes"] + p2["argument_bytes"]
+    assert m["peak_hbm_bytes"] == \
+        max(p1["peak_hbm_bytes"], p2["peak_hbm_bytes"])
+    profiling.reset()
+
+
+def test_memory_baseline_round_trip(tmp_path):
+    from mxnet_tpu import profiling
+    profiling.reset()
+    _register_toy("toy:memrt",
+                  lambda a, b: (a @ b).sum(axis=0),
+                  jnp.ones((64, 64), jnp.float32),
+                  jnp.ones((64, 64), jnp.float32))
+    base_path = str(tmp_path / "memory_baseline.json")
+    base = memory.save_audit(base_path)
+    assert memory.load_audit(base_path)["schema"] == memory.AUDIT_SCHEMA
+
+    # self-diff: zero drift, CLI exit 0
+    assert memory.diff_audit(base, base) == []
+    assert an.main(["--memory-diff", base_path, base_path]) == 0
+
+    # seeded regression: peak HBM +50%
+    cur = json.loads(json.dumps(base))
+    row = cur["executables"]["toy:memrt"]["metrics"]
+    row["peak_hbm_bytes"] = int(row["peak_hbm_bytes"] * 1.5)
+    cur_path = str(tmp_path / "current.json")
+    with open(cur_path, "w") as f:
+        json.dump(cur, f)
+    diags = memory.diff_audit(base, memory.load_audit(cur_path))
+    assert sorted({d.rule for d in diags}) == ["memory-drift"]
+    assert "peak HBM grew" in diags[0].message
+    assert "+50.0%" in diags[0].message
+    assert an.main(["--memory-diff", base_path, cur_path]) == 1
+
+    # an executable the baseline never blessed is a drift error
+    new = json.loads(json.dumps(base))
+    new["executables"]["toy:unblessed"] = \
+        json.loads(json.dumps(base["executables"]["toy:memrt"]))
+    diags = memory.diff_audit(base, new)
+    assert len(diags) == 1 and "unblessed executable" in diags[0].message
+
+    # an advisory kind the baseline doesn't carry is a drift error
+    adv = json.loads(json.dumps(base))
+    adv["executables"]["toy:memrt"]["advisories"].append(
+        {"kind": "temp-share", "share": 0.9, "dominant_category": None,
+         "message": "seeded"})
+    diags = memory.diff_audit(base, adv)
+    assert len(diags) == 1 and "temp-share" in diags[0].message
+
+    # shrinkage passes silently
+    better = json.loads(json.dumps(base))
+    brow = better["executables"]["toy:memrt"]["metrics"]
+    brow["peak_hbm_bytes"] = int(brow["peak_hbm_bytes"] * 0.5)
+    better["executables"]["toy:memrt"]["advisories"] = []
+    assert memory.diff_audit(base, better) == []
+    profiling.reset()
+
+
+def test_memory_audit_schema_reject(tmp_path):
+    p = tmp_path / "bogus.json"
+    p.write_text(json.dumps({"schema": "nope", "executables": {}}))
+    with pytest.raises(ValueError, match="mxmemory.audit.v1"):
+        memory.load_audit(str(p))
+    assert an.main(["--memory-diff", str(p), str(p)]) == 2
+
+
+def test_memory_diff_tolerance_env(monkeypatch):
+    base = {"executables": {"e": {"metrics": {"peak_hbm_bytes": 1000},
+                                  "advisories": []}}}
+    cur = {"executables": {"e": {"metrics": {"peak_hbm_bytes": 1300},
+                                 "advisories": []}}}
+    assert memory.diff_audit(base, cur, tol=0.5) == []
+    assert len(memory.diff_audit(base, cur, tol=0.02)) == 1
+    monkeypatch.setenv("MXNET_TPU_MEMORY_AUDIT_TOL", "0.5")
+    assert memory.diff_audit(base, cur) == []
+
+
+def test_committed_memory_baseline_is_loadable():
+    base = memory.load_audit(
+        os.path.join(REPO, "ci", "memory_baseline.json"))
+    labels = set(base["executables"])
+    assert "train_step:MemLeNet" in labels
+    for row in base["executables"].values():
+        assert "peak_hbm_bytes" in row["metrics"]
+
+
+# ----------------------------------------------------------------------
+# hbm_plan: extrapolation anchored on two real compiles
+# ----------------------------------------------------------------------
+
+def test_hbm_plan_extrapolation_matches_real_compiles():
+    import jax
+
+    def f(w, x):
+        return jnp.tanh(x @ w).sum()
+
+    jfn = jax.jit(f)
+    w = jax.ShapeDtypeStruct((64, 32), jnp.float32)
+    x8 = jax.ShapeDtypeStruct((8, 64), jnp.float32)
+    plan = memory.hbm_plan("probe:tanhmm", buckets=(8, 16, 32),
+                           batch_size=8, fn=jfn, args=(w, x8),
+                           device_hbm_bytes=1 << 30)
+    measured = {}
+    for b in (8, 16, 32):
+        xb = jax.ShapeDtypeStruct((b, 64), jnp.float32)
+        measured[b] = memory.executable_memory(
+            jfn.lower(w, xb).compile())["peak_hbm_bytes"]
+    # the two anchor buckets ARE real compiles: prediction is exact
+    assert plan["measured"] == {"8": measured[8], "16": measured[16]}
+    pred = {r["batch"]: r["predicted_peak_hbm_bytes"]
+            for r in plan["buckets"]}
+    assert abs(pred[8] - measured[8]) <= 1
+    assert abs(pred[16] - measured[16]) <= 1
+    # the extrapolated bucket tracks the actual compile
+    assert abs(pred[32] - measured[32]) <= max(0.25 * measured[32], 64)
+    assert plan["per_item_bytes"] > 0
+    assert all(r["fits"] for r in plan["buckets"])
+    assert plan["largest_fit_bucket"] == 32
+    # a budget below the smallest bucket fits nothing
+    tight = memory.hbm_plan("probe:tanhmm", buckets=(8, 16),
+                            batch_size=8, fn=jfn, args=(w, x8),
+                            device_hbm_bytes=1)
+    assert tight["largest_fit_bucket"] is None
+    assert not any(r["fits"] for r in tight["buckets"])
+
+
+def test_hbm_plan_errors():
+    import jax
+    from mxnet_tpu import profiling
+    profiling.reset()
+    with pytest.raises(ValueError, match="no registered executable"):
+        memory.hbm_plan("nope:missing")
+    jfn = jax.jit(lambda w: w * 2.0)
+    w = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    with pytest.raises(ValueError, match="batch dim"):
+        memory.hbm_plan("probe:nobatch", batch_size=8, fn=jfn,
+                        args=(w,))
+
+
+# ----------------------------------------------------------------------
+# mxprof drive-by: peak HBM merges as MAX, never as a sum
+# ----------------------------------------------------------------------
+
+def test_mxprof_merge_peak_is_max(tmp_path):
+    from mxnet_tpu.profiling import cli as pcli
+
+    def _combined(peak):
+        return {"schema": pcli.COMBINED_SCHEMA, "steps": {},
+                "executables": [],
+                "totals": {"flops": 1.0, "bytes_accessed": 10.0,
+                           "peak_hbm_bytes": peak},
+                "categories": {}}
+
+    p1, p2 = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    with open(p1, "w") as f:
+        json.dump(_combined(300), f)
+    with open(p2, "w") as f:
+        json.dump(_combined(100), f)
+    merged = pcli._collect([p1, p2], None)
+    assert merged["totals"]["peak_hbm_bytes"] == 300   # max, not 400
+    assert merged["totals"]["flops"] == 2.0            # flops DO add
+    text = "\n".join(pcli._render_report(merged)) \
+        if isinstance(pcli._render_report(merged), list) \
+        else pcli._render_report(merged)
+    assert "peak HBM 300 B" in text
+    assert "max over executables" in text
+
+
+# ----------------------------------------------------------------------
+# runtime layer: census, sentinel, chaos, statusz
+# ----------------------------------------------------------------------
+
+def test_live_census_buckets_known_array():
+    memory.reset_watch()
+    marker = jnp.ones((977, 3), jnp.float32)
+    census = memory.live_census()
+    key = "(977, 3)/float32"
+    assert key in census["buckets"]
+    bucket = census["buckets"][key]
+    assert bucket["count"] >= 1
+    assert bucket["bytes"] >= 977 * 3 * 4
+    assert census["bytes_total"] >= bucket["bytes"]
+    assert census["arrays"] >= bucket["count"]
+    assert memory._STATE["censuses"] == 1
+    assert memory._STATE["live_bytes"] == census["bytes_total"]
+    del marker
+
+
+def test_watch_disarmed_is_one_flag_check():
+    memory._set_watch(False)
+    memory.reset_watch()
+    assert memory.watch_enabled() is False
+    # the trainer's hot-path pattern: the guard is a single module-flag
+    # read, so the sentinel is never constructed and no census runs
+    if memory.watch_enabled():
+        memory.sentinel().step()
+    assert memory._SENTINEL is None
+    assert memory._STATE["censuses"] == 0
+    row = memory.status_row()
+    assert row["armed"] is False and row["censuses"] == 0
+
+
+@pytest.fixture
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.disarm()
+    chaos.reset()
+
+
+def test_leak_sentinel_flags_chaos_pins_within_three_windows(
+        _clean_chaos):
+    memory._set_watch(True)
+    memory.reset_watch()
+    s = memory.sentinel(window_steps=1, min_baseline=3,
+                        min_growth_frac=0.01)
+    chaos.on("memory.leak", memory.pin_action)
+    # warm the baseline on clean windows (chaos still disarmed)
+    for i in range(4):
+        chaos.fail_point("memory.leak", step=i)
+        s.step()
+    assert memory.pinned_count() == 0
+    assert s.baseline()["n"] == 4
+    assert memory._STATE["leaks"] == 0
+    # pin size scaled to the ambient live set so the MAD threshold is
+    # crossed regardless of what earlier tests left alive
+    nbytes = int(memory._STATE["live_bytes"] * 0.3) + (16 << 20)
+    chaos.arm(seed=0)
+    flagged_at = None
+    for i in range(6):
+        chaos.fail_point("memory.leak", step=i, nbytes=nbytes)
+        s.step()
+        if memory._STATE["leaks"]:
+            flagged_at = i
+            break
+    assert memory.pinned_count() >= 1
+    assert flagged_at is not None and flagged_at < 3, \
+        "chaos-pinned growth not flagged within 3 windows"
+    leak = memory._STATE["last_leak"]
+    # the report NAMES the pinned shape bucket
+    assert leak["bucket"] == \
+        "(%d,)/float32" % max(1, nbytes // 4)
+    assert leak["growth_bytes"] > 0
+    assert leak["live_bytes"] > leak["baseline_bytes"]
+    assert s.last()["leak"] is not None
+    assert memory.status_row()["leaks"] == 1
+    assert memory.unpin_all() >= 1
+
+
+def test_leak_sentinel_clean_run_never_flags(_clean_chaos):
+    memory._set_watch(True)
+    memory.reset_watch()
+    s = memory.sentinel(window_steps=1, min_baseline=3,
+                        min_growth_frac=0.01)
+    for i in range(10):
+        s.step()
+    assert memory._STATE["leaks"] == 0
+    assert memory._STATE["censuses"] == 10
+    assert s.last()["leak"] is None
+
+
+def test_leak_sentinel_publish_guard_skips_judge_and_baseline():
+    memory._set_watch(True)
+    memory.reset_watch()
+    s = memory.LeakSentinel(window_steps=1, min_baseline=1,
+                            min_growth_frac=0.01)
+    for _ in range(3):
+        s.step()
+    n0 = s.baseline()["n"]
+    # a checkpoint-sized spike inside a publish-guarded window: the
+    # window neither flags nor teaches the baseline
+    memory.pin_action({"nbytes": int(
+        memory._STATE["live_bytes"] * 0.5) + (32 << 20)})
+    s.note_publish()
+    s.step()
+    report = s.last()
+    assert report["publishes"] == 1
+    assert report["leak"] is None
+    assert s.baseline()["n"] == n0
+    assert memory._STATE["leaks"] == 0
+    memory.unpin_all()
+
+
+def test_trainer_wiring_is_guarded():
+    import inspect
+    from mxnet_tpu.serving import loop
+    run_src = inspect.getsource(loop.ContinuousTrainer.run_steps)
+    assert '_chaos.fail_point("memory.leak"' in run_src
+    assert "_memory.watch_enabled()" in run_src
+    assert "_memory.sentinel().step()" in run_src
+    assert "note_publish" in \
+        inspect.getsource(loop.ContinuousTrainer.publish)
+    assert "sentinel().flush()" in \
+        inspect.getsource(loop.ContinuousTrainer.close)
+
+
+# ----------------------------------------------------------------------
+# surfaces: statusz, runtime features, env vars, telemetry, SARIF
+# ----------------------------------------------------------------------
+
+def test_statusz_carries_memory_row():
+    from mxnet_tpu.obs import status
+    row = status.statusz()["memory"]
+    assert set(row) == {"armed", "censuses", "live_bytes",
+                        "live_arrays", "leaks", "last_leak", "pinned"}
+    assert row["armed"] == memory.watch_enabled()
+
+
+def test_runtime_features_memory_watch_row(monkeypatch):
+    from mxnet_tpu import runtime
+    monkeypatch.setenv("MXNET_TPU_MEMORY_WATCH", "1")
+    assert runtime.Features().is_enabled("MEMORY_WATCH")
+    monkeypatch.delenv("MXNET_TPU_MEMORY_WATCH")
+    assert not runtime.Features().is_enabled("MEMORY_WATCH")
+
+
+def test_memory_env_vars_registered():
+    from mxnet_tpu import env
+    desc = env.describe()
+    assert "MXNET_TPU_MEMORY_WATCH" in desc
+    assert "MXNET_TPU_MEMORY_AUDIT_TOL" in desc
+    _val, default, _doc = desc["MXNET_TPU_MEMORY_AUDIT_TOL"]
+    assert default == 0.02
+
+
+def test_memory_telemetry_instruments_catalogued():
+    from mxnet_tpu.telemetry import hooks
+    rows = {i.name: i for i in hooks.INSTRUMENTS}
+    assert rows["memory.censuses"].kind == "counter"
+    assert rows["memory.live_bytes"].kind == "gauge"
+    assert rows["memory.live_arrays"].kind == "gauge"
+    assert rows["memory.leaks"].kind == "counter"
+    assert rows["memory.leak"].kind == "event"
+
+
+def test_memory_rules_sarif_export(tmp_path):
+    src = (
+        "import collections\n"
+        "_CACHE = {}\n"
+        "class Feeder:\n"
+        "    def __init__(self):\n"
+        "        self.feed_q = collections.deque()\n"
+        "def compiled_for(x, build):\n"
+        "    key = (x.shape, str(x.dtype))\n"
+        "    _CACHE[key] = build(x)\n"
+        "    return _CACHE[key]\n"
+    )
+    diags = _lint(src)
+    fired = set(_mem(diags))
+    assert fired == {"unbounded-shape-cache", "feed-depth-unbounded"}
+    log = an.to_sarif(diags)
+    results = log["runs"][0]["results"]
+    assert fired <= {r["ruleId"] for r in results}
+    # rule metadata covers the new rules
+    rule_ids = {m["id"] for m in log["runs"][0]["tool"]["driver"]["rules"]}
+    assert fired <= rule_ids
+    out = str(tmp_path / "memory.sarif")
+    assert an.write_sarif(out, diags) == log
+    with open(out) as f:
+        assert json.load(f) == log

@@ -404,9 +404,20 @@ def test_mid_decode_swap_drains_old_zero_dropped(registry, params,
     p1 = MODEL.init_params(1)
     registry.register_generative("gpt", MODEL, params=params,
                                  **ENGINE_KW)
+    old = registry._servables["gpt"]
     with chaos.scenario(seed=0):
-        chaos.on("serving.decode.step",
-                 action=lambda ctx: time.sleep(0.03))
+        # gate every decode step until the REPLACEMENT servable has
+        # installed: the swap then provably lands mid-generation
+        # (install precedes old.close(drain=True) in the registry), and
+        # the drain -- which only starts after install -- releases the
+        # gate.  The first token comes from prefill, so next(stream)
+        # never blocks on this.
+        def _hold_until_swapped(ctx, deadline=None):
+            deadline = deadline or time.monotonic() + 10.0
+            while (registry._servables.get("gpt") is old
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+        chaos.on("serving.decode.step", action=_hold_until_swapped)
         stream = registry.generate("gpt", [3, 7, 1, 9, 2], 20)
         first = next(stream)             # mid-generation from here on
         registry.register_generative("gpt", MODEL, params=p1,
